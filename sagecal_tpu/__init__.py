@@ -1,0 +1,24 @@
+"""sagecal-tpu: TPU-native direction-dependent radio-interferometric calibration.
+
+A ground-up JAX/XLA re-design of the capabilities of SAGECal
+(nlesc-dirac/sagecal): per-station, per-direction 2x2 Jones calibration by
+SAGE/EM-partitioned Levenberg-Marquardt, (stochastic) LBFGS and Riemannian
+trust-region solvers, Gaussian / robust Student's-t noise models, sky-model
+prediction (point/Gaussian/disk/ring/shapelet sources, station + element
+beams), multi-frequency consensus ADMM over a device mesh, spatial
+regularization, and federated calibration.
+
+Layering (mirrors the reference's libdirac / libdirac-radio / apps split,
+reference SURVEY.md section 1):
+
+- ``sagecal_tpu.core``     data model: visibilities, baselines, Jones layout
+- ``sagecal_tpu.ops``      RIME prediction, beams, shapelets, special functions
+- ``sagecal_tpu.solvers``  LM / LBFGS / RTR / NSD / robust EM / SAGE driver
+- ``sagecal_tpu.parallel`` mesh, consensus ADMM, manifold averaging, federated
+- ``sagecal_tpu.io``       MS-like data access, sky-model / solution files
+- ``sagecal_tpu.apps``     calibration pipelines and CLI
+"""
+
+__version__ = "0.1.0"
+
+from sagecal_tpu.core import types as _types  # noqa: F401

@@ -1,0 +1,16 @@
+from sagecal_tpu.core.types import (
+    VisData,
+    JonesSolution,
+    params_to_jones,
+    jones_to_params,
+)
+from sagecal_tpu.core.baselines import generate_baselines, tile_baselines
+
+__all__ = [
+    "VisData",
+    "JonesSolution",
+    "params_to_jones",
+    "jones_to_params",
+    "generate_baselines",
+    "tile_baselines",
+]

@@ -1,0 +1,40 @@
+"""Baseline enumeration and tiling.
+
+Replaces the reference's ``generate_baselines`` / ``rearrange_*`` machinery
+(``/root/reference/src/lib/Dirac/baseline_utils.c``): instead of building
+pthread-partitioned C structs, we emit flat index arrays that serve as
+gather indices inside jitted kernels — the XLA analog of the reference's
+flattened GPU layouts ``ddcoh``/``ddbase``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def generate_baselines(nstations: int) -> tuple[np.ndarray, np.ndarray]:
+    """All cross-correlation pairs p < q; returns (ant_p, ant_q) int32 arrays
+    of length N(N-1)/2 (ordering matches the reference's nested station loop,
+    baseline_utils.c)."""
+    p, q = np.triu_indices(nstations, k=1)
+    return p.astype(np.int32), q.astype(np.int32)
+
+
+def tile_baselines(
+    nstations: int, tilesz: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Baseline index arrays for a whole tile of ``tilesz`` timeslots.
+
+    Returns (ant_p, ant_q, time_idx), each of length nbase*tilesz, baseline
+    varying fastest (the reference's IOData row order, src/MS/data.h:48-73).
+    """
+    p, q = generate_baselines(nstations)
+    nbase = p.shape[0]
+    ant_p = np.tile(p, tilesz)
+    ant_q = np.tile(q, tilesz)
+    time_idx = np.repeat(np.arange(tilesz, dtype=np.int32), nbase)
+    return ant_p, ant_q, time_idx
+
+
+def count_baselines(nstations: int) -> int:
+    return nstations * (nstations - 1) // 2

@@ -1,0 +1,180 @@
+"""Core data model: visibilities, Jones parameter layout, flags.
+
+Design notes
+------------
+The reference stores a visibility row as 8 doubles (XX,XY,YX,YY x re,im;
+ordering documented at ``/root/reference/src/lib/Dirac/Dirac.h:1617-1618``)
+and a station's Jones solution as 8 reals ``S0..S7`` with
+``J = [S0+jS1, S4+jS5; S2+jS3, S6+jS7]`` (``/root/reference/README.md``
+section 6).  Here visibilities are native complex arrays of shape
+``(rows, nchan, 2, 2)`` — the 2x2 coherency matrix is a trailing axis so
+XLA batches the tiny matmuls of the RIME (J_p C J_q^H) across rows on the
+MXU/VPU — and Jones solutions are ``(..., nstations, 2, 2)`` complex.  The
+8-real S-ordering only exists at the text-file boundary
+(:mod:`sagecal_tpu.io.solutions`) for byte-compatibility with the
+reference's solution format.
+
+Solver parameter vectors are *real* (shape ``(..., 8*N)``) like the
+reference's ``p`` vectors (``/root/reference/src/lib/Dirac/lmfit.c``),
+because LM / LBFGS line searches and trust regions are real-valued
+optimizers.  :func:`params_to_jones` / :func:`jones_to_params` convert, and
+their ordering matches the reference so solution files can be diffed
+directly against ``sagecal`` output.
+
+Everything is a pytree (``flax.struct``) so whole datasets can be passed
+through ``jit`` / ``shard_map`` boundaries and sharded over a mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+# Speed of light (m/s), used to convert metre uvw to wavelengths (the
+# reference scales u,v,w by 1/c once per tile, fullbatch_mode.cpp:320-322).
+C0 = 299792458.0
+
+
+@struct.dataclass
+class VisData:
+    """One tile (solution interval) of visibility data, flattened over time.
+
+    ``rows = nbase * tilesz`` with baseline varying fastest inside each
+    timeslot (same layout the reference's ``Data::IOData`` uses,
+    ``/root/reference/src/MS/data.h:48-73``).
+
+    Attributes:
+      u, v, w:  (rows,) baseline coordinates in *seconds* (metres / c).
+      ant_p, ant_q: (rows,) int32 station indices of each baseline.
+      vis: (rows, nchan, 2, 2) complex observed coherencies.
+      mask: (rows, nchan) 1.0 = good, 0.0 = flagged. Multiplicative, so
+        flagged rows contribute zero to every residual/gradient reduction
+        (replaces the reference's preset_flags_and_data zeroing,
+        ``/root/reference/src/lib/Dirac/baseline_utils.c``).
+      freqs: (nchan,) channel frequencies in Hz.
+      time_idx: (rows,) int32 timeslot index within the tile (0..tilesz-1).
+      freq0: reference frequency (Hz) of the channel-averaged data.
+      deltaf: total bandwidth (Hz), used for frequency smearing.
+      deltat: integration time (s), used for time smearing.
+      tilesz: static number of timeslots in this tile.
+      nbase: static number of baselines per timeslot.
+      nstations: static number of stations N.
+    """
+
+    u: jax.Array
+    v: jax.Array
+    w: jax.Array
+    ant_p: jax.Array
+    ant_q: jax.Array
+    vis: jax.Array
+    mask: jax.Array
+    freqs: jax.Array
+    time_idx: jax.Array
+    freq0: float = struct.field(pytree_node=False, default=150e6)
+    deltaf: float = struct.field(pytree_node=False, default=180e3)
+    deltat: float = struct.field(pytree_node=False, default=1.0)
+    tilesz: int = struct.field(pytree_node=False, default=1)
+    nbase: int = struct.field(pytree_node=False, default=0)
+    nstations: int = struct.field(pytree_node=False, default=0)
+
+    @property
+    def rows(self) -> int:
+        return self.nbase * self.tilesz
+
+    @property
+    def nchan(self) -> int:
+        return self.vis.shape[1]
+
+
+@struct.dataclass
+class JonesSolution:
+    """Per-cluster, per-chunk, per-station Jones solutions for one tile.
+
+    ``jones``: (nclus, nchunk_max, nstations, 2, 2) complex. Clusters whose
+    hybrid chunk count (cluster-file column 2; reference README section 2b)
+    is smaller than ``nchunk_max`` repeat their last valid chunk — the
+    padding is inert because chunk->row maps never reference it.
+    ``nchunk``: (nclus,) int32 actual chunk counts.
+    """
+
+    jones: jax.Array
+    nchunk: jax.Array
+
+
+def params_to_jones(p: jax.Array) -> jax.Array:
+    """Real parameter vector (..., 8N) -> complex Jones (..., N, 2, 2).
+
+    Ordering per station (matches the reference solution-file contract,
+    ``/root/reference/README.md`` section 6): ``[Re J00, Im J00, Re J10,
+    Im J10, Re J01, Im J01, Re J11, Im J11]``.
+    """
+    s = p.reshape(p.shape[:-1] + (-1, 4, 2))  # (..., N, 4, 2) [S0S1|S2S3|S4S5|S6S7]
+    z = jax.lax.complex(s[..., 0], s[..., 1])  # (..., N, 4): J00, J10, J01, J11
+    j00, j10, j01, j11 = z[..., 0], z[..., 1], z[..., 2], z[..., 3]
+    row0 = jnp.stack([j00, j01], axis=-1)
+    row1 = jnp.stack([j10, j11], axis=-1)
+    return jnp.stack([row0, row1], axis=-2)
+
+
+def jones_to_params(jones: jax.Array) -> jax.Array:
+    """Complex Jones (..., N, 2, 2) -> real parameter vector (..., 8N)."""
+    j00 = jones[..., 0, 0]
+    j10 = jones[..., 1, 0]
+    j01 = jones[..., 0, 1]
+    j11 = jones[..., 1, 1]
+    z = jnp.stack([j00, j10, j01, j11], axis=-1)  # (..., N, 4)
+    s = jnp.stack([jnp.real(z), jnp.imag(z)], axis=-1)  # (..., N, 4, 2)
+    return s.reshape(s.shape[:-3] + (-1,))
+
+
+def identity_jones(nstations: int, dtype=jnp.complex64) -> jax.Array:
+    """(N, 2, 2) stack of identity Jones matrices (the reference's default
+    initialization, fullbatch_mode.cpp:206-237)."""
+    return jnp.broadcast_to(jnp.eye(2, dtype=dtype), (nstations, 2, 2))
+
+
+def real_dtype_of(dtype) -> jnp.dtype:
+    return jnp.finfo(dtype).dtype if jnp.issubdtype(dtype, jnp.floating) else jnp.real(
+        jnp.zeros((), dtype)
+    ).dtype
+
+
+def herm(m: jax.Array) -> jax.Array:
+    """Conjugate transpose on the trailing 2x2 axes."""
+    return jnp.conj(jnp.swapaxes(m, -1, -2))
+
+
+def mat2x2_inv(m: jax.Array) -> jax.Array:
+    """Closed-form inverse of trailing 2x2 matrices."""
+    a = m[..., 0, 0]
+    b = m[..., 0, 1]
+    c = m[..., 1, 0]
+    d = m[..., 1, 1]
+    det = a * d - b * c
+    inv = jnp.stack(
+        [
+            jnp.stack([d, -b], axis=-1),
+            jnp.stack([-c, a], axis=-1),
+        ],
+        axis=-2,
+    )
+    return inv / det[..., None, None]
+
+
+def apply_gains(jones: jax.Array, coh: jax.Array, ant_p: jax.Array, ant_q: jax.Array) -> jax.Array:
+    """The RIME corruption  V_pq = J_p C_pq J_q^H.
+
+    jones: (N, 2, 2) complex; coh: (rows, ..., 2, 2); ant_p/ant_q: (rows,).
+    Batched 2x2 matmuls — XLA lowers these to MXU-batched GEMMs.
+    """
+    jp = jones[ant_p]  # (rows, 2, 2)
+    jq = jones[ant_q]
+    extra = coh.ndim - jp.ndim
+    for _ in range(extra):
+        jp = jp[:, None]
+        jq = jq[:, None]
+    return jp @ coh @ herm(jq)

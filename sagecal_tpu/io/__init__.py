@@ -1,0 +1,1 @@
+from sagecal_tpu.io import simulate, skymodel, solutions  # noqa: F401

@@ -1,0 +1,1 @@
+from sagecal_tpu.ops import special, rime  # noqa: F401

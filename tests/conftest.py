@@ -1,0 +1,30 @@
+"""Test configuration: hermetic 8-virtual-device CPU JAX.
+
+Multi-device tests use JAX's host-platform device emulation in place of
+the reference's copy-the-MS-N-times MPI recipe
+(/root/reference/test/Calibration/README.md steps 1-4).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-selects the TPU backend via
+# jax.config.update("jax_platforms", "axon,cpu"); undo it for hermetic tests.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]

@@ -1,0 +1,85 @@
+import jax.numpy as jnp
+import numpy as np
+
+from sagecal_tpu.core.baselines import count_baselines, generate_baselines, tile_baselines
+from sagecal_tpu.core.types import (
+    apply_gains,
+    herm,
+    identity_jones,
+    jones_to_params,
+    mat2x2_inv,
+    params_to_jones,
+)
+
+
+def test_generate_baselines():
+    p, q = generate_baselines(4)
+    assert count_baselines(4) == 6
+    assert p.shape == (6,)
+    assert np.all(p < q)
+    pairs = set(zip(p.tolist(), q.tolist()))
+    assert len(pairs) == 6
+
+
+def test_tile_baselines_layout():
+    p, q, t = tile_baselines(3, 2)
+    assert p.shape == (6,)
+    # baseline-fastest ordering
+    assert t.tolist() == [0, 0, 0, 1, 1, 1]
+    assert p[:3].tolist() == p[3:].tolist()
+
+
+def test_params_jones_roundtrip():
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.standard_normal(8 * 5), jnp.float32)
+    j = params_to_jones(p)
+    assert j.shape == (5, 2, 2)
+    p2 = jones_to_params(j)
+    np.testing.assert_allclose(p, p2, rtol=1e-6)
+
+
+def test_params_jones_ordering():
+    # S-ordering: J = [S0+jS1, S4+jS5; S2+jS3, S6+jS7] (README section 6)
+    p = jnp.asarray(np.arange(8, dtype=np.float32))
+    j = params_to_jones(p)
+    np.testing.assert_allclose(j[0, 0, 0], 0 + 1j)
+    np.testing.assert_allclose(j[0, 1, 0], 2 + 3j)
+    np.testing.assert_allclose(j[0, 0, 1], 4 + 5j)
+    np.testing.assert_allclose(j[0, 1, 1], 6 + 7j)
+
+
+def test_mat2x2_inv():
+    rng = np.random.default_rng(1)
+    m = jnp.asarray(
+        rng.standard_normal((7, 2, 2)) + 1j * rng.standard_normal((7, 2, 2)),
+        jnp.complex64,
+    )
+    inv = mat2x2_inv(m)
+    eye = m @ inv
+    np.testing.assert_allclose(np.asarray(eye), np.broadcast_to(np.eye(2), (7, 2, 2)), atol=1e-5)
+
+
+def test_apply_gains_identity():
+    rng = np.random.default_rng(2)
+    coh = jnp.asarray(
+        rng.standard_normal((10, 3, 2, 2)) + 1j * rng.standard_normal((10, 3, 2, 2)),
+        jnp.complex64,
+    )
+    ant_p = jnp.asarray(np.arange(10) % 4)
+    ant_q = jnp.asarray((np.arange(10) + 1) % 4)
+    out = apply_gains(identity_jones(4), coh, ant_p, ant_q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(coh), atol=1e-6)
+
+
+def test_apply_gains_formula():
+    j = jnp.asarray(
+        np.random.default_rng(3).standard_normal((4, 2, 2))
+        + 1j * np.random.default_rng(4).standard_normal((4, 2, 2)),
+        jnp.complex64,
+    )
+    coh = jnp.asarray(np.eye(2)[None, None], jnp.complex64)
+    out = apply_gains(j, jnp.broadcast_to(coh, (1, 1, 2, 2)), jnp.asarray([1]), jnp.asarray([2]))
+    expect = np.asarray(j[1]) @ np.asarray(np.conj(j[2]).T)
+    np.testing.assert_allclose(np.asarray(out[0, 0]), expect, rtol=1e-5)
+    # herm helper
+    np.testing.assert_allclose(np.asarray(herm(j)[0]), np.conj(np.asarray(j[0])).T)

@@ -1,0 +1,160 @@
+import jax.numpy as jnp
+import numpy as np
+import scipy.special
+
+from sagecal_tpu.io.simulate import make_visdata
+from sagecal_tpu.ops.rime import (
+    ST_DISK,
+    ST_GAUSSIAN,
+    ST_RING,
+    SourceBatch,
+    point_source_batch,
+    predict_coherencies,
+    uv_cut_mask,
+)
+from sagecal_tpu.ops.special import bessel_j0, bessel_j1, sinc_abs
+
+
+def test_bessel_vs_scipy():
+    x = np.linspace(-30, 30, 4001)
+    np.testing.assert_allclose(
+        np.asarray(bessel_j0(jnp.asarray(x))), scipy.special.j0(x), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(bessel_j1(jnp.asarray(x))), scipy.special.j1(x), atol=1e-6
+    )
+
+
+def test_sinc_abs():
+    np.testing.assert_allclose(np.asarray(sinc_abs(jnp.asarray([0.0]))), [1.0])
+    x = np.array([0.5, -2.0])
+    np.testing.assert_allclose(
+        np.asarray(sinc_abs(jnp.asarray(x))), np.abs(np.sin(x) / x), rtol=1e-6
+    )
+
+
+def test_point_source_at_center():
+    # source at phase center: coherency == [[I,0],[0,I]] on every baseline
+    d = make_visdata(nstations=5, tilesz=2, nchan=2)
+    src = point_source_batch(jnp.asarray([0.0]), jnp.asarray([0.0]), jnp.asarray([2.5]))
+    coh = predict_coherencies(d.u, d.v, d.w, d.freqs, src)
+    expect = np.broadcast_to(2.5 * np.eye(2), coh.shape)
+    np.testing.assert_allclose(np.asarray(coh), expect, atol=1e-4)
+
+
+def test_point_source_phase_closed_form():
+    d = make_visdata(nstations=4, tilesz=1, nchan=3)
+    ll, mm, flux = 0.01, -0.02, 1.7
+    src = point_source_batch(jnp.asarray([ll]), jnp.asarray([mm]), jnp.asarray([flux]))
+    coh = np.asarray(predict_coherencies(d.u, d.v, d.w, d.freqs, src))
+    u, v, w = np.asarray(d.u), np.asarray(d.v), np.asarray(d.w)
+    nn = np.sqrt(1 - ll * ll - mm * mm) - 1.0
+    for f in range(3):
+        ph = np.exp(
+            2j
+            * np.pi
+            * float(d.freqs[f])
+            * (u * ll + v * mm + w * nn)
+        )
+        np.testing.assert_allclose(coh[:, f, 0, 0], flux * ph, rtol=3e-4, atol=1e-5)
+        np.testing.assert_allclose(coh[:, f, 0, 1], 0.0, atol=1e-6)
+        np.testing.assert_allclose(coh[:, f, 1, 1], flux * ph, rtol=3e-4, atol=1e-5)
+
+
+def test_full_stokes_matrix():
+    d = make_visdata(nstations=3, tilesz=1, nchan=1)
+    src = point_source_batch(jnp.asarray([0.0]), jnp.asarray([0.0]), jnp.asarray([1.0]))
+    src = src.replace(sQ0=jnp.asarray([0.1]), sU0=jnp.asarray([0.2]), sV0=jnp.asarray([0.3]))
+    coh = np.asarray(predict_coherencies(d.u, d.v, d.w, d.freqs, src))[0, 0]
+    # C = [[I+Q, U+iV], [U-iV, I-Q]] (predict.c:200-212)
+    np.testing.assert_allclose(coh[0, 0], 1.1, atol=1e-5)
+    np.testing.assert_allclose(coh[0, 1], 0.2 + 0.3j, atol=1e-5)
+    np.testing.assert_allclose(coh[1, 0], 0.2 - 0.3j, atol=1e-5)
+    np.testing.assert_allclose(coh[1, 1], 0.9, atol=1e-5)
+
+
+def test_gaussian_at_center_attenuation():
+    # gaussian at phase center: projection is identity; factor
+    # exp(-2 pi^2 (a^2 u^2 + b^2 v^2)) in wavelengths (predict.c:46-58)
+    d = make_visdata(nstations=5, tilesz=1, nchan=1)
+    sig = 2e-4
+    src = point_source_batch(jnp.asarray([0.0]), jnp.asarray([0.0]), jnp.asarray([1.0]))
+    src = src.replace(
+        stype=jnp.asarray([ST_GAUSSIAN]),
+        ex_a=jnp.asarray([sig], jnp.float32),
+        ex_b=jnp.asarray([sig], jnp.float32),
+    )
+    coh = np.asarray(predict_coherencies(d.u, d.v, d.w, d.freqs, src))[:, 0, 0, 0]
+    f = float(d.freqs[0])
+    ul, vl = np.asarray(d.u) * f, np.asarray(d.v) * f
+    expect = np.exp(-2 * np.pi**2 * sig**2 * (ul**2 + vl**2))
+    np.testing.assert_allclose(coh.real, expect, rtol=2e-3, atol=1e-5)
+
+
+def test_disk_ring_factors():
+    d = make_visdata(nstations=4, tilesz=1, nchan=1)
+    rad = 5e-4
+    base = point_source_batch(jnp.asarray([0.0]), jnp.asarray([0.0]), jnp.asarray([1.0]))
+    f = float(d.freqs[0])
+    r_uv = 2 * np.pi * rad * np.sqrt((np.asarray(d.u) * f) ** 2 + (np.asarray(d.v) * f) ** 2)
+    disk = base.replace(stype=jnp.asarray([ST_DISK]), ex_a=jnp.asarray([rad], jnp.float32))
+    ring = base.replace(stype=jnp.asarray([ST_RING]), ex_a=jnp.asarray([rad], jnp.float32))
+    cd = np.asarray(predict_coherencies(d.u, d.v, d.w, d.freqs, disk))[:, 0, 0, 0]
+    cr = np.asarray(predict_coherencies(d.u, d.v, d.w, d.freqs, ring))[:, 0, 0, 0]
+    np.testing.assert_allclose(cd.real, scipy.special.j1(r_uv), atol=3e-4)
+    np.testing.assert_allclose(cr.real, scipy.special.j0(r_uv), atol=3e-4)
+
+
+def test_spectral_index():
+    d = make_visdata(nstations=3, tilesz=1, nchan=2, freq0=150e6, chan_bw=20e6)
+    src = point_source_batch(
+        jnp.asarray([0.0]), jnp.asarray([0.0]), jnp.asarray([2.0]), f0=120e6
+    )
+    src = src.replace(spec_idx=jnp.asarray([-0.7], jnp.float32))
+    coh = np.asarray(predict_coherencies(d.u, d.v, d.w, d.freqs, src))
+    for f in range(2):
+        expect = np.exp(np.log(2.0) - 0.7 * np.log(float(d.freqs[f]) / 120e6))
+        np.testing.assert_allclose(coh[:, f, 0, 0].real, expect, rtol=2e-4)
+
+
+def test_negative_flux_sign_preserved():
+    d = make_visdata(nstations=3, tilesz=1, nchan=1)
+    src = point_source_batch(
+        jnp.asarray([0.0]), jnp.asarray([0.0]), jnp.asarray([-1.5]), f0=140e6
+    )
+    src = src.replace(spec_idx=jnp.asarray([-0.5], jnp.float32))
+    coh = np.asarray(predict_coherencies(d.u, d.v, d.w, d.freqs, src))
+    expect = -np.exp(np.log(1.5) - 0.5 * np.log(150e6 / 140e6))
+    np.testing.assert_allclose(coh[:, 0, 0, 0].real, expect, rtol=2e-4)
+
+
+def test_source_chunking_invariance():
+    d = make_visdata(nstations=4, tilesz=1, nchan=1)
+    rng = np.random.default_rng(5)
+    S = 7
+    src = point_source_batch(
+        jnp.asarray(0.01 * rng.standard_normal(S), jnp.float32),
+        jnp.asarray(0.01 * rng.standard_normal(S), jnp.float32),
+        jnp.asarray(rng.uniform(0.5, 2, S), jnp.float32),
+    )
+    a = np.asarray(predict_coherencies(d.u, d.v, d.w, d.freqs, src, source_chunk=2))
+    b = np.asarray(predict_coherencies(d.u, d.v, d.w, d.freqs, src, source_chunk=32))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_uv_cut_mask():
+    u = jnp.asarray([1.0, 10.0, 100.0]) / 150e6
+    v = jnp.zeros(3)
+    m = np.asarray(uv_cut_mask(u, v, 150e6, uvmin=5.0, uvmax=50.0))
+    np.testing.assert_array_equal(m, [0.0, 1.0, 0.0])
+
+
+def test_freq_smearing_reduces_amplitude():
+    d = make_visdata(nstations=5, tilesz=1, nchan=1)
+    src = point_source_batch(jnp.asarray([0.05]), jnp.asarray([0.0]), jnp.asarray([1.0]))
+    c0 = np.asarray(predict_coherencies(d.u, d.v, d.w, d.freqs, src, fdelta=0.0))
+    c1 = np.asarray(predict_coherencies(d.u, d.v, d.w, d.freqs, src, fdelta=1e6))
+    amp0 = np.abs(c0[:, 0, 0, 0])
+    amp1 = np.abs(c1[:, 0, 0, 0])
+    assert np.all(amp1 <= amp0 + 1e-6)
+    assert np.any(amp1 < amp0 - 1e-3)
