@@ -8,10 +8,9 @@ against ``/root/reference/src/lib/Radio/readsky.c:285-500``):
   (RA in hours->rad via pi/12, dec in degrees->rad, negative-zero aware);
 - cluster line: ``cluster_id chunk_size source1 source2 ...``; negative
   cluster_id means "do not subtract from data";
-- source type by name prefix of its first character per the reference's
-  convention (G/D/R/S prefixes select Gaussian/disk/ring/shapelet when the
-  extent fields are nonzero — here we follow readsky.c's actual rule:
-  extent fields nonzero => extended; type letter = first char of name);
+- source type selected purely by the first character of the source name
+  (G/g Gaussian, D/d disk, R/r ring, S/s shapelet, anything else point) —
+  the extent columns play NO role in the type decision (readsky.c:425-509);
 - shapelet mode files ``<name>.fits.modes`` (readsky.c:143-163).
 
 Parsing is plain numpy on the host — it happens once per run; the output
@@ -100,9 +99,6 @@ def parse_skymodel(path: str, three_term_spectra: Optional[bool] = None) -> dict
             name = tok[0]
             vals = [float(x) for x in tok[1 : 19 if fmt3 else 17]]
             (rahr, ramin, rasec, decd, decmin, decsec, sI, sQ, sU, sV) = vals[:10]
-            # re-read sign of the raw strings to catch "-0"
-            rahr = math.copysign(rahr, -1.0) if tok[1].startswith("-") and rahr == 0 else rahr
-            decd = math.copysign(decd, -1.0) if tok[4].startswith("-") and decd == 0 else decd
             if fmt3:
                 si, si1, si2, _rm, eX, eY, eP, f0 = vals[10:18]
             else:

@@ -127,23 +127,35 @@ def pad_source_batch(src: SourceBatch, target: int) -> SourceBatch:
         return jnp.pad(x, cfg)
 
     out = jax.tree_util.tree_map(_pad, src)
-    # keep f0 strictly positive in padding to avoid log(0)
-    return out.replace(f0=jnp.where(out.f0 <= 0, 1.0, out.f0))
+    # keep f0 strictly positive in padding to avoid log(0); padding sources
+    # must keep the "-1 = not a shapelet" invariant, not jnp.pad's 0
+    pad_mask = jnp.arange(target) >= S
+    return out.replace(
+        f0=jnp.where(out.f0 <= 0, 1.0, out.f0),
+        shapelet_idx=jnp.where(pad_mask, -1, out.shapelet_idx),
+    )
 
 
 def _spectral_flux(s0, f0, si, si1, si2, freqs):
     """Per-channel flux with sign preservation (readsky.c:353-377).
 
-    s0,(S,) flux at f0; freqs (F,) -> (S, F).
+    s0,(S,) flux at f0; freqs (F,) -> (S, F).  The reference gates ALL
+    spectral scaling on spec_idx != 0 (readsky.c:358): a source with
+    si == 0 keeps its raw catalog flux even if si1/si2 are nonzero.
+    Zero-flux handling uses the double-where pattern so gradients w.r.t.
+    a zero flux are 0, not NaN.
     """
     lf = jnp.log(freqs[None, :] / f0[:, None])  # (S, F)
+    zero = s0 == 0.0
+    safe_abs = jnp.where(zero, 1.0, jnp.abs(s0))
     mag = jnp.exp(
-        jnp.log(jnp.maximum(jnp.abs(s0), 1e-300))[:, None]
+        jnp.log(safe_abs)[:, None]
         + si[:, None] * lf
         + si1[:, None] * lf**2
         + si2[:, None] * lf**3
     )
-    return jnp.where(s0[:, None] == 0.0, 0.0, jnp.sign(s0)[:, None] * mag)
+    scaled = jnp.where(zero[:, None], 0.0, jnp.sign(s0)[:, None] * mag)
+    return jnp.where(si[:, None] == 0.0, s0[:, None], scaled)
 
 
 def _shape_factor(src: SourceBatch, u, v, w, freqs):
@@ -202,6 +214,12 @@ def predict_coherencies(
     S = src.nsources
     chunk = min(source_chunk, S) if S > 0 else 1
     nchunks = -(-S // chunk)
+    # skip the extended-source math entirely for pure point-source batches
+    # (the overwhelmingly common case) when stype is concrete
+    try:
+        has_extended = bool(jnp.any(src.stype != ST_POINT))
+    except jax.errors.TracerBoolConversionError:
+        has_extended = True
     padded = pad_source_batch(src, nchunks * chunk)
     # reshape every per-source leaf to (nchunks, chunk)
     chunked = jax.tree_util.tree_map(
@@ -221,8 +239,10 @@ def predict_coherencies(
         ang = freqs[:, None, None] * G[None]
         ph = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
         smear = sinc_abs(G * (0.5 * fdelta))[None]  # (1, rows, chunk)
-        shape = _shape_factor(c, u, v, w, freqs)  # (F, rows, chunk)
-        amp = (smear * shape).astype(ph.real.dtype)
+        if has_extended:
+            amp = (smear * _shape_factor(c, u, v, w, freqs)).astype(ph.real.dtype)
+        else:
+            amp = jnp.broadcast_to(smear, ph.shape).astype(ph.real.dtype)
         phs = ph * amp  # (F, rows, chunk)
         # Stokes coherency (chunk, F, 4) complex
         I = _spectral_flux(c.sI0, c.f0, c.spec_idx, c.spec_idx1, c.spec_idx2, freqs)
@@ -254,6 +274,8 @@ def predict_model(
     """
     from sagecal_tpu.core.types import apply_gains
 
+    if not clusters:
+        raise ValueError("predict_model: empty cluster list")
     total = None
     for ci, src in enumerate(clusters):
         coh = predict_coherencies(u, v, w, freqs, src, fdelta, source_chunk)

@@ -1,0 +1,1 @@
+from sagecal_tpu.solvers import lbfgs, lm, robust  # noqa: F401

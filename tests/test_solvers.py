@@ -1,0 +1,157 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sagecal_tpu.core.types import jones_to_params, params_to_jones, identity_jones
+from sagecal_tpu.io.simulate import corrupt_and_observe, make_visdata, random_jones
+from sagecal_tpu.ops.rime import point_source_batch, predict_coherencies
+from sagecal_tpu.solvers.lbfgs import LBFGSMemory, lbfgs_fit
+from sagecal_tpu.solvers.lm import LMConfig, lm_solve, os_lm_solve
+from sagecal_tpu.solvers.robust import robust_lm_solve, update_w_and_nu
+
+
+def rosenbrock(x):
+    # the reference's own LBFGS oracle (test/Dirac/demo.c:95): min at 1...1
+    return jnp.sum(100.0 * (x[1::2] - x[0::2] ** 2) ** 2 + (1.0 - x[0::2]) ** 2)
+
+
+def test_lbfgs_rosenbrock():
+    n = 20
+    x0 = jnp.asarray(np.full(n, -1.2), jnp.float32)
+    res = lbfgs_fit(rosenbrock, None, x0, itmax=200, M=7)
+    assert float(res.cost) < 1e-3, float(res.cost)
+    np.testing.assert_allclose(np.asarray(res.p), np.ones(n), atol=0.05)
+
+
+def test_lbfgs_jit_compatible():
+    n = 8
+    fit = jax.jit(lambda x0: lbfgs_fit(rosenbrock, None, x0, itmax=100, M=5).p)
+    p = fit(jnp.asarray(np.full(n, 0.5), jnp.float32))
+    np.testing.assert_allclose(np.asarray(p), np.ones(n), atol=0.05)
+
+
+def test_lbfgs_minibatch_memory_persists():
+    # quadratic with batch-dependent data: memory threads across calls
+    n = 6
+    A = jnp.asarray(np.diag(np.arange(1, n + 1)), jnp.float32)
+
+    def make_cost(shift):
+        return lambda x: 0.5 * jnp.dot(x - shift, A @ (x - shift))
+
+    mem = LBFGSMemory.init(n, M=4)
+    x = jnp.ones((n,), jnp.float32) * 5.0
+    for b in range(3):
+        cost = make_cost(jnp.zeros(n))
+        res = lbfgs_fit(cost, None, x, itmax=10, M=4, memory=mem, minibatch=True)
+        x, mem = res.p, res.memory
+    assert int(mem.niter) > 0
+    assert float(jnp.linalg.norm(x)) < 0.5
+
+
+def _simulated_single_cluster(nst=7, tilesz=2, noise=0.0, seed=3):
+    d = make_visdata(nstations=nst, tilesz=tilesz, nchan=1, seed=seed)
+    rng = np.random.default_rng(seed)
+    S = 3
+    src = point_source_batch(
+        jnp.asarray(0.01 * rng.standard_normal(S), jnp.float32),
+        jnp.asarray(0.01 * rng.standard_normal(S), jnp.float32),
+        jnp.asarray(rng.uniform(1.0, 3.0, S), jnp.float32),
+    )
+    J = random_jones(1, nst, seed=seed, amp=0.2)
+    obs = corrupt_and_observe(d, [src], jones=J, noise_sigma=noise, seed=seed + 1)
+    coh = predict_coherencies(d.u, d.v, d.w, d.freqs, src)
+    return d, obs, coh, J
+
+
+def _gain_consistency_err(j_est, j_true, coh, ant_p, ant_q):
+    """Compare J_p C J_q^H predictions (gauge-invariant comparison)."""
+    from sagecal_tpu.core.types import apply_gains
+
+    m1 = apply_gains(j_est, coh, ant_p, ant_q)
+    m2 = apply_gains(j_true, coh, ant_p, ant_q)
+    return float(jnp.max(jnp.abs(m1 - m2)) / jnp.max(jnp.abs(m2)))
+
+
+def test_lm_recovers_jones():
+    d, obs, coh, J = _simulated_single_cluster()
+    nst = d.nstations
+    p0 = jones_to_params(identity_jones(nst))[None]  # (1, 8N)
+    chunk_map = jnp.zeros((obs.vis.shape[0],), jnp.int32)
+    res = lm_solve(
+        obs.vis, coh, obs.mask, obs.ant_p, obs.ant_q, chunk_map, p0,
+        LMConfig(itmax=30),
+    )
+    assert float(res.cost[0]) < 1e-5 * float(res.cost0[0]), (res.cost0, res.cost)
+    j_est = params_to_jones(res.p)[0]
+    err = _gain_consistency_err(j_est, J[0], coh, obs.ant_p, obs.ant_q)
+    assert err < 1e-2, err
+
+
+def test_lm_hybrid_chunks():
+    # two chunks solving two halves of the tile with different true gains
+    d = make_visdata(nstations=6, tilesz=2, nchan=1, seed=11)
+    rng = np.random.default_rng(11)
+    src = point_source_batch(
+        jnp.asarray([0.0, 0.01], jnp.float32),
+        jnp.asarray([0.005, -0.01], jnp.float32),
+        jnp.asarray([2.0, 1.0], jnp.float32),
+    )
+    coh = predict_coherencies(d.u, d.v, d.w, d.freqs, src)
+    J2 = random_jones(2, 6, seed=12, amp=0.15)  # one per chunk
+    from sagecal_tpu.core.types import apply_gains
+
+    chunk_map = d.time_idx  # timeslot == chunk
+    jp = J2[chunk_map, d.ant_p]
+    jq = J2[chunk_map, d.ant_q]
+    vis = jp[:, None] @ coh @ jnp.conj(jnp.swapaxes(jq, -1, -2))[:, None]
+    p0 = jnp.broadcast_to(jones_to_params(identity_jones(6))[None], (2, 8 * 6))
+    res = lm_solve(vis, coh, d.mask, d.ant_p, d.ant_q, chunk_map, p0, LMConfig(itmax=30))
+    assert np.all(np.asarray(res.cost) < 1e-5 * np.asarray(res.cost0))
+
+
+def test_os_lm_reduces_cost():
+    d, obs, coh, J = _simulated_single_cluster(nst=8, tilesz=2)
+    p0 = jones_to_params(identity_jones(8))[None]
+    chunk_map = jnp.zeros((obs.vis.shape[0],), jnp.int32)
+    res = os_lm_solve(
+        obs.vis, coh, obs.mask, obs.ant_p, obs.ant_q, chunk_map, p0,
+        LMConfig(itmax=16), nsubsets=4,
+    )
+    assert float(res.cost[0]) < 1e-3 * float(res.cost0[0])
+
+
+def test_update_w_and_nu():
+    rng = np.random.default_rng(0)
+    nu_true = 4.0
+    e = jnp.asarray(rng.standard_t(nu_true, 20000), jnp.float32)
+    sqrt_w, nu = update_w_and_nu(e, jnp.asarray(8.0))
+    w = np.asarray(sqrt_w) ** 2
+    # heavy-tail points get down-weighted
+    assert w[np.abs(np.asarray(e)) > 5].max() < 0.5
+    assert 2.0 <= float(nu) <= 10.0
+
+
+def test_robust_lm_with_outliers():
+    d, obs, coh, J = _simulated_single_cluster(nst=7, tilesz=2, noise=1e-3)
+    # inject gross outliers into 5% of rows
+    rng = np.random.default_rng(9)
+    vis = np.asarray(obs.vis).copy()
+    bad = rng.choice(vis.shape[0], size=vis.shape[0] // 20, replace=False)
+    vis[bad] += 50.0 * (rng.standard_normal((len(bad), 1, 2, 2)) + 1j)
+    visj = jnp.asarray(vis)
+    p0 = jones_to_params(identity_jones(7))[None]
+    chunk_map = jnp.zeros((vis.shape[0],), jnp.int32)
+    res_r, nu = robust_lm_solve(
+        visj, coh, obs.mask, obs.ant_p, obs.ant_q, chunk_map, p0,
+        em_iters=3, config=LMConfig(itmax=20),
+    )
+    j_rob = params_to_jones(res_r.p)[0]
+    err_rob = _gain_consistency_err(j_rob, J[0], coh, obs.ant_p, obs.ant_q)
+    # plain LM on the same corrupted data
+    res_g = lm_solve(
+        visj, coh, obs.mask, obs.ant_p, obs.ant_q, chunk_map, p0, LMConfig(itmax=20)
+    )
+    j_gau = params_to_jones(res_g.p)[0]
+    err_gau = _gain_consistency_err(j_gau, J[0], coh, obs.ant_p, obs.ant_q)
+    assert err_rob < err_gau, (err_rob, err_gau)
+    assert err_rob < 0.05, err_rob
