@@ -1,0 +1,148 @@
+"""Benchmark: joint LBFGS calibration throughput (north-star metric #1).
+
+Workload: 62-station LOFAR-like array, 100 source clusters, one tile of
+5 timeslots x 2 channels — the robust joint-LBFGS pass that closes every
+SAGE iteration (``lbfgs_fit_robust_wrapper``, /root/reference/src/lib/
+Dirac/lmfit.c:1019-1037), which is the dominant full-parameter solver
+in both the fullbatch and stochastic modes (BASELINE.md north-star:
+"LBFGS iters/sec/chip, 62-station, 100-cluster").
+
+Each LBFGS iteration evaluates the full 100-cluster RIME model
+(predict J C J^H summed over clusters) and its gradient by autodiff —
+the same work the reference does per iteration with threaded C kernels
+(robust_lbfgs.c:94,155).
+
+``vs_baseline``: ratio against the same algorithm in float64 on the
+host CPU via the JAX CPU backend (the reference is CPU double +
+pthreads; no published numbers exist in the reference repo —
+BASELINE.md).  The CPU figure was measured on this machine and is
+pinned below so the driver run only measures the TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+# Measured 2026-07-29 on this container's CPU (JAX CPU backend, float64,
+# same workload as below, median of 3 runs after compile):
+#   python -c "import bench, numpy as np; print(bench.run(np.float64))"
+# with JAX_PLATFORMS=cpu and x64 enabled -> 0.407 iters/sec.
+CPU_BASELINE_ITERS_PER_SEC = 0.407
+
+NSTATIONS = 62
+NCLUSTERS = 100
+TILESZ = 5
+NCHAN = 2
+LBFGS_ITERS = 20
+REPEATS = 3
+
+
+def build_workload(dtype=np.float32):
+    """Synthesize the 62-stn/100-cluster tile.  MUST run on the CPU
+    backend: eager complex ops and complex host<->device transfers are
+    unimplemented on the axon TPU backend (verify skill gotchas 3)."""
+    import jax.numpy as jnp
+
+    from sagecal_tpu.core.types import jones_to_params
+    from sagecal_tpu.io.simulate import corrupt_and_observe, make_visdata, random_jones
+    from sagecal_tpu.ops.rime import point_source_batch
+    from sagecal_tpu.solvers.sage import build_cluster_data
+
+    rng = np.random.default_rng(0)
+    f0 = 150e6
+    fdt = jnp.float32 if dtype == np.float32 else jnp.float64
+    cdt = np.complex64 if dtype == np.float32 else np.complex128
+    data = make_visdata(
+        nstations=NSTATIONS, tilesz=TILESZ, nchan=NCHAN, freq0=f0, dtype=dtype
+    )
+    ll = rng.uniform(-0.05, 0.05, NCLUSTERS)
+    mm = rng.uniform(-0.05, 0.05, NCLUSTERS)
+    flux = rng.uniform(0.5, 5.0, NCLUSTERS)
+    clusters = [
+        point_source_batch([ll[k]], [mm[k]], [flux[k]], f0=f0, dtype=fdt)
+        for k in range(NCLUSTERS)
+    ]
+    jones = random_jones(NCLUSTERS, NSTATIONS, seed=1, amp=0.15, dtype=cdt)
+    data = corrupt_and_observe(data, clusters, jones=jones, noise_sigma=1e-3)
+    cdata = build_cluster_data(data, clusters, [1] * NCLUSTERS)
+    p0 = jones_to_params(
+        random_jones(NCLUSTERS, NSTATIONS, seed=2, amp=0.0, dtype=cdt)
+    )[:, None, :]
+    return data, cdata, p0
+
+
+def make_step(data, cdata, nu=5.0):
+    """Jitted LBFGS step over a REAL-array boundary (complex packed as a
+    trailing re/im axis — axon cannot transfer complex)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sagecal_tpu.solvers.lbfgs import lbfgs_fit
+    from sagecal_tpu.solvers.sage import predict_full_model
+
+    M, nchunk, n8 = NCLUSTERS, 1, 8 * NSTATIONS
+
+    @jax.jit
+    def step(vis_ri, mask, coh_ri, p0):
+        vis = jax.lax.complex(vis_ri[..., 0], vis_ri[..., 1])
+        coh = jax.lax.complex(coh_ri[..., 0], coh_ri[..., 1])
+        d = data.replace(vis=vis, mask=mask)
+        c = cdata._replace(coh=coh)
+
+        def cost_fn(pflat):
+            pa = pflat.reshape(M, nchunk, n8)
+            model = predict_full_model(pa, c, d)
+            diff = (vis - model) * mask[..., None, None]
+            e2 = jnp.real(diff) ** 2 + jnp.imag(diff) ** 2
+            return jnp.sum(jnp.log1p(e2 / nu))
+
+        fit = lbfgs_fit(cost_fn, None, p0.reshape(-1), itmax=LBFGS_ITERS, M=7)
+        return fit.p, fit.cost, fit.iterations
+
+    return step
+
+
+def run(dtype=np.float32):
+    import jax
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        data, cdata, p0 = build_workload(dtype)
+    vis_ri = np.stack([np.asarray(data.vis.real), np.asarray(data.vis.imag)], -1)
+    coh_ri = np.stack([np.asarray(cdata.coh.real), np.asarray(cdata.coh.imag)], -1)
+    mask = np.asarray(data.mask)
+    p0_h = np.asarray(p0)
+    step = make_step(data, cdata)
+    args = (vis_ri, mask, coh_ri, p0_h)
+    out = step(*args)  # compile + first run
+    jax.block_until_ready(out)
+    iters = int(np.asarray(out[2]))
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        out = step(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    dt = float(np.median(times))
+    return max(iters, 1) / dt, iters
+
+
+def main():
+    value, iters = run(np.float32)
+    vs = value / CPU_BASELINE_ITERS_PER_SEC if CPU_BASELINE_ITERS_PER_SEC else None
+    print(
+        json.dumps(
+            {
+                "metric": "lbfgs_cal_iters_per_sec",
+                "value": round(value, 3),
+                "unit": "iter/s (62 stn, 100 clusters, 5 ts x 2 ch)",
+                "vs_baseline": round(vs, 3) if vs else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,9 @@ Math conventions (verified against the reference):
 
 from __future__ import annotations
 
+import functools
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -43,6 +46,35 @@ ST_GAUSSIAN = 1
 ST_DISK = 2
 ST_RING = 3
 ST_SHAPELET = 4
+
+
+@struct.dataclass
+class ShapeletTable:
+    """Padded struct-of-arrays table of shapelet models for one cluster
+    (or sky).  Sources reference rows by ``SourceBatch.shapelet_idx``.
+    Models with fewer than ``n0max`` orders zero-pad ``modes`` — exact,
+    since unused basis coefficients are zero.
+
+    modes: (K, n0max*n0max); beta/eX/eY/eP: (K,).
+    """
+
+    modes: jax.Array
+    beta: jax.Array
+    eX: jax.Array
+    eY: jax.Array
+    eP: jax.Array
+    n0max: int = struct.field(pytree_node=False, default=1)
+
+    @staticmethod
+    def empty(dtype=jnp.float32) -> "ShapeletTable":
+        return ShapeletTable(
+            modes=jnp.zeros((1, 1), dtype),
+            beta=jnp.ones((1,), dtype),
+            eX=jnp.ones((1,), dtype),
+            eY=jnp.ones((1,), dtype),
+            eP=jnp.zeros((1,), dtype),
+            n0max=1,
+        )
 
 
 @struct.dataclass
@@ -192,6 +224,39 @@ def _shape_factor(src: SourceBatch, u, v, w, freqs):
     return fac
 
 
+def _shapelet_factor(c: SourceBatch, tab: ShapeletTable, u, v, w, freqs):
+    """Complex shapelet uv factor (F, rows, chunk) for the chunk's
+    ST_SHAPELET members (``shapelet_contrib``, shapelet.c:141-188):
+    tangent-plane projection with negated signs, (1/eX, 1/eY, eP) linear
+    transform, mode sum, scaled by 2*pi*a*b."""
+    from sagecal_tpu.ops.shapelets import uv_mode_vectors
+
+    idx = jnp.clip(c.shapelet_idx, 0, tab.modes.shape[0] - 1)
+    beta = tab.beta[idx]
+    a = 1.0 / tab.eX[idx]
+    b = 1.0 / tab.eY[idx]
+    eP = tab.eP[idx]
+    modes = tab.modes[idx]  # (chunk, n0max^2)
+    up = (
+        -u[:, None] * c.cxi[None, :]
+        + v[:, None] * c.cphi[None, :] * c.sxi[None, :]
+        - w[:, None] * c.sphi[None, :] * c.sxi[None, :]
+    )  # (rows, chunk), seconds
+    vp = (
+        -u[:, None] * c.sxi[None, :]
+        - v[:, None] * c.cphi[None, :] * c.cxi[None, :]
+        + w[:, None] * c.sphi[None, :] * c.cxi[None, :]
+    )
+    upf = freqs[:, None, None] * up[None]  # wavelengths (F, rows, chunk)
+    vpf = freqs[:, None, None] * vp[None]
+    cp, sp = jnp.cos(eP), jnp.sin(eP)
+    ut = a * (cp * upf - sp * vpf)
+    vt = b * (sp * upf + cp * vpf)
+    Av = uv_mode_vectors(-ut, vt, beta, tab.n0max)  # (F, rows, chunk, n0^2)
+    sfac = jnp.einsum("frsm,sm->frs", Av, modes.astype(Av.dtype))
+    return (2.0 * jnp.pi) * (a * b)[None, None, :] * sfac
+
+
 def predict_coherencies(
     u: jax.Array,
     v: jax.Array,
@@ -200,6 +265,7 @@ def predict_coherencies(
     src: SourceBatch,
     fdelta: float = 0.0,
     source_chunk: int = 32,
+    shapelets: Optional[ShapeletTable] = None,
 ) -> jax.Array:
     """Sum of source coherencies on every baseline row: (rows, F, 2, 2) complex.
 
@@ -208,18 +274,43 @@ def predict_coherencies(
     per-cluster inner loop.  ``fdelta`` is the *per-channel* bandwidth for
     smearing (the reference passes total-bandwidth/Nchan when predicting
     channel-averaged data).
+
+    ``shapelets``: mode table for ST_SHAPELET members.  NOTE: shapelet
+    uv factors are evaluated at each channel's frequency, not the
+    reference's freq0-only approximation (predict.c:200).
     """
+    # skip the extended-source math entirely for pure point-source batches
+    # (the overwhelmingly common case) when stype is concrete
+    try:
+        stype_np = np.asarray(src.stype)
+        has_extended = bool(np.any(stype_np != ST_POINT))
+        has_shapelet = bool(np.any(stype_np == ST_SHAPELET))
+    except (jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError):
+        has_extended = True
+        has_shapelet = shapelets is not None
+    if shapelets is None:
+        if has_shapelet:
+            raise ValueError(
+                "SourceBatch contains ST_SHAPELET sources but no ShapeletTable "
+                "was supplied — they would silently predict as point sources"
+            )
+        has_shapelet = False
+        shapelets = ShapeletTable.empty(u.dtype)
+    return _predict_coherencies(
+        u, v, w, freqs, src, shapelets,
+        float(fdelta), int(source_chunk), has_extended, has_shapelet,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7, 8, 9))
+def _predict_coherencies(
+    u, v, w, freqs, src, shapelets, fdelta, source_chunk, has_extended, has_shapelet
+):
     rows = u.shape[0]
     F = freqs.shape[0]
     S = src.nsources
     chunk = min(source_chunk, S) if S > 0 else 1
     nchunks = -(-S // chunk)
-    # skip the extended-source math entirely for pure point-source batches
-    # (the overwhelmingly common case) when stype is concrete
-    try:
-        has_extended = bool(jnp.any(src.stype != ST_POINT))
-    except jax.errors.TracerBoolConversionError:
-        has_extended = True
     padded = pad_source_batch(src, nchunks * chunk)
     # reshape every per-source leaf to (nchunks, chunk)
     chunked = jax.tree_util.tree_map(
@@ -244,6 +335,10 @@ def predict_coherencies(
         else:
             amp = jnp.broadcast_to(smear, ph.shape).astype(ph.real.dtype)
         phs = ph * amp  # (F, rows, chunk)
+        if has_shapelet:
+            fac_s = _shapelet_factor(c, shapelets, u, v, w, freqs)
+            sel = (c.stype == ST_SHAPELET)[None, None, :]
+            phs = jnp.where(sel, ph * smear * fac_s.astype(phs.dtype), phs)
         # Stokes coherency (chunk, F, 4) complex
         I = _spectral_flux(c.sI0, c.f0, c.spec_idx, c.spec_idx1, c.spec_idx2, freqs)
         Q = _spectral_flux(c.sQ0, c.f0, c.spec_idx, c.spec_idx1, c.spec_idx2, freqs)
@@ -263,12 +358,13 @@ def predict_coherencies(
 
 def predict_model(
     u, v, w, freqs, clusters, fdelta=0.0, jones=None, ant_p=None, ant_q=None,
-    source_chunk: int = 32,
+    source_chunk: int = 32, shapelet_tables=None,
 ):
     """Full-sky model visibilities: sum over a list of clusters, each
     optionally corrupted by its own Jones solution.
 
     ``clusters``: list of SourceBatch.  ``jones``: optional (nclus, N, 2, 2).
+    ``shapelet_tables``: optional per-cluster ShapeletTable (or None).
     Equivalent of ``predict_visibilities_multifreq[_withsol]``
     (residual.c:1257,1621).
     """
@@ -278,7 +374,10 @@ def predict_model(
         raise ValueError("predict_model: empty cluster list")
     total = None
     for ci, src in enumerate(clusters):
-        coh = predict_coherencies(u, v, w, freqs, src, fdelta, source_chunk)
+        tab = shapelet_tables[ci] if shapelet_tables is not None else None
+        coh = predict_coherencies(
+            u, v, w, freqs, src, fdelta, source_chunk, shapelets=tab
+        )
         if jones is not None:
             coh = apply_gains(jones[ci], coh, ant_p, ant_q)
         total = coh if total is None else total + coh

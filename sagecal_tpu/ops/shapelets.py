@@ -1,0 +1,163 @@
+"""Shapelet (Gauss-Hermite) source models: UV- and image-plane bases.
+
+Redesign of ``/root/reference/src/lib/Radio/shapelet.c``.  The reference
+evaluates Hermite polynomials with a doubly-recursive function per uv
+point per mode (``H_e``, shapelet.c:31) inside the per-baseline thread
+loop; here the 1-D basis is one ``lax.scan`` recurrence producing all
+``n0`` orders for every point at once, and the 2-D mode tensor is an
+outer product — the mode sum over n0^2 coefficients becomes a matmul
+over points.
+
+Math (verified against shapelet.c:49-188):
+- 1-D dimensionless basis  phi_n(x) = H_n(x) exp(-x^2/2) /
+  sqrt(2^(n+1) n!)   (shapelet.c:88-97; physicists' Hermite).
+- 2-D UV mode (n1,n2) at (u,v):  sign * phi_n1(u*beta) * phi_n2(v*beta),
+  real when n1+n2 even (sign (-1)^((n1+n2)/2)), imaginary when odd
+  (sign (-1)^((n1+n2-1)/2)) — the i^(n1+n2) factor of the Fourier
+  transform of the image-plane basis.
+- source contribution: 2*pi * a*b * sum_modes  c_m * mode_m evaluated at
+  the projected, (1/eX,1/eY,eP)-transformed, u-negated uv point
+  (shapelet.c:141-188; uv supplied in wavelengths, predict.c:200).
+- image-plane basis (for the ``restore`` tool):  phi_n(x/beta) /
+  sqrt(beta) with the same normalization.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+def hermite_basis_1d(x: jax.Array, n0: int) -> jax.Array:
+    """phi_n(x) = H_n(x) exp(-x^2/2)/sqrt(2^(n+1) n!) for n < n0.
+
+    x: (...,) -> (..., n0).  One scan over orders; each step is O(points).
+    """
+    expv = jnp.exp(-0.5 * x * x)
+    # normalization 1/sqrt(2^(n+1) n!)
+    lognorm = np.array(
+        [-0.5 * ((n + 1) * math.log(2.0) + math.lgamma(n + 1)) for n in range(n0)]
+    )
+    norm = jnp.asarray(np.exp(lognorm), x.dtype)
+    if n0 == 1:
+        return (expv * norm[0])[..., None]
+
+    def step(carry, n):
+        h_nm1, h_nm2 = carry
+        h_n = 2.0 * x * h_nm1 - 2.0 * (n - 1).astype(x.dtype) * h_nm2
+        return (h_n, h_nm1), h_n
+
+    h0 = jnp.ones_like(x)
+    h1 = 2.0 * x
+    _, hs = jax.lax.scan(step, (h1, h0), jnp.arange(2, n0))
+    H = jnp.concatenate(
+        [h0[None], h1[None], hs], axis=0
+    )  # (n0, ...)
+    H = jnp.moveaxis(H, 0, -1)  # (..., n0)
+    return H * expv[..., None] * norm
+
+
+def uv_mode_signs(n0: int):
+    """(sign, is_imag) arrays of shape (n0, n0) for modes (n1, n2)
+    (shapelet.c:110-127); index [n2, n1] matches the reference's
+    column-major mode ordering modes[n2*n0+n1]."""
+    n1 = np.arange(n0)[None, :]
+    n2 = np.arange(n0)[:, None]
+    s = n1 + n2
+    is_imag = (s % 2) == 1
+    sign = np.where(is_imag, (-1.0) ** (((s - 1) // 2) % 2), (-1.0) ** ((s // 2) % 2))
+    return sign, is_imag
+
+
+def uv_mode_vectors(u: jax.Array, v: jax.Array, beta: float, n0: int) -> jax.Array:
+    """Complex mode tensor (..., n0*n0): mode (n1,n2) at flat index
+    n2*n0+n1 (``calculate_uv_mode_vectors_scalar``, shapelet.c:49-137,
+    with the real/imag parity folded into a complex value)."""
+    pu = hermite_basis_1d(u * beta, n0)  # (..., n0) over n1
+    pv = hermite_basis_1d(v * beta, n0)  # (..., n0) over n2
+    prod = pv[..., :, None] * pu[..., None, :]  # (..., n2, n1)
+    sign, is_imag = uv_mode_signs(n0)
+    fac = jnp.asarray(np.where(is_imag, 1j, 1.0) * sign, jnp.complex64 if u.dtype == jnp.float32 else jnp.complex128)
+    out = prod * fac
+    return out.reshape(out.shape[:-2] + (n0 * n0,))
+
+
+@struct.dataclass
+class ShapeletModel:
+    """One shapelet source's model (``exinfo_shapelet``,
+    Dirac_common.h:74-85): modes c_m (n0*n0,), scale beta, optional
+    linear transform (eX, eY, eP) and tangent-plane projection angles."""
+
+    modes: jax.Array  # (n0*n0,)
+    beta: float = struct.field(pytree_node=False)
+    n0: int = struct.field(pytree_node=False)
+    eX: float = struct.field(pytree_node=False, default=1.0)
+    eY: float = struct.field(pytree_node=False, default=1.0)
+    eP: float = struct.field(pytree_node=False, default=0.0)
+
+
+def shapelet_uv_contrib(
+    u, v, w, model: ShapeletModel,
+    cxi=1.0, sxi=0.0, cphi=1.0, sphi=0.0, use_projection: bool = True,
+):
+    """Complex visibility-plane factor of a shapelet source at uv points
+    given in WAVELENGTHS (``shapelet_contrib``, shapelet.c:141-188).
+
+    u, v, w: (...,) arrays.  Returns complex (...,).
+    """
+    if use_projection:
+        up = -u * cxi + v * cphi * sxi - w * sphi * sxi
+        vp = -u * sxi - v * cphi * cxi + w * sphi * cxi
+    else:
+        up, vp = u, v
+    a = 1.0 / model.eX
+    b = 1.0 / model.eY
+    cp, sp = math.cos(model.eP), math.sin(model.eP)
+    ut = a * (cp * up - sp * vp)
+    vt = b * (sp * up + cp * vp)
+    # decomposition of f(-l, m): negate u
+    Av = uv_mode_vectors(-ut, vt, model.beta, model.n0)  # (..., n0^2) complex
+    s = Av @ model.modes.astype(Av.dtype)
+    return 2.0 * jnp.pi * a * b * s
+
+
+def image_mode_matrix(l, m, beta: float, n0: int) -> jax.Array:
+    """Image-plane basis matrix (..., n0*n0): mode (n1,n2) evaluated at
+    (l, m)/beta, normalized by 1/beta (``shapelet_modes`` role;
+    shapelet.c image-plane half).  Used by the restore tool and the
+    spatial-regularization basis."""
+    pu = hermite_basis_1d(l / beta, n0) / jnp.sqrt(jnp.asarray(beta, l.dtype))
+    pv = hermite_basis_1d(m / beta, n0) / jnp.sqrt(jnp.asarray(beta, l.dtype))
+    prod = pv[..., :, None] * pu[..., None, :]
+    return prod.reshape(prod.shape[:-2] + (n0 * n0,))
+
+
+def hermite_product_tensor(n0a: int, n0b: int, n0c: int, nquad: int = 64):
+    """3-way Hermite-basis product integrals T[i,j,k] =
+    int phi_i(x) phi_j(x) phi_k(x) dx via Gauss-Hermite quadrature
+    (the ``shapelet_product`` tensors, shapelet.c:523-553, used to apply
+    a spatial model Z to a shapelet diffuse sky).  Host-side numpy
+    (precomputed once), returns (n0a, n0b, n0c)."""
+    x, wq = np.polynomial.hermite.hermgauss(nquad)
+    # our phi_n(x) includes exp(-x^2/2); quadrature weight exp(-x^2) is
+    # the product of two of the three gaussians; multiply back the third
+    # explicitly: phi_i phi_j phi_k = H~_i H~_j H~_k exp(-3x^2/2)
+    def phi(n, xx):
+        H = np.polynomial.hermite.hermval(xx, np.eye(max(n0a, n0b, n0c))[n])
+        return H / np.sqrt(2.0 ** (n + 1) * math.factorial(n))
+
+    T = np.zeros((n0a, n0b, n0c))
+    ex = np.exp(-0.5 * x * x)  # the third gaussian factor
+    for i in range(n0a):
+        pi = phi(i, x)
+        for j in range(n0b):
+            pj = phi(j, x)
+            for k in range(n0c):
+                pk = phi(k, x)
+                T[i, j, k] = np.sum(wq * pi * pj * pk * ex)
+    return jnp.asarray(T)

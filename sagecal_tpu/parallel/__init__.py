@@ -1,0 +1,1 @@
+"""Distributed / multi-frequency consensus layer (mesh-parallel ADMM)."""

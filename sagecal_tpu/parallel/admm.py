@@ -1,0 +1,100 @@
+"""Consensus-constrained local calibration: the ADMM "x-step".
+
+Redesign of ``sagefit_visibilities_admm`` (``/root/reference/src/lib/
+Dirac/admm_solve.c:221``): an EM pass over clusters where each
+per-cluster solve minimizes the data misfit PLUS the scaled-Lagrangian
+consensus terms ``y^T (J - BZ) + rho/2 ||J - BZ||^2`` (cost contract
+Dirac.h:1182-1195).  The reference dispatches to RTR/NSD/LM ADMM
+variants per solver mode; here the augmented terms enter the batched
+LM's normal equations exactly (they are quadratic), so one lock-step
+solver covers all chunks, and the EM structure is the shared
+:func:`sagecal_tpu.solvers.sage.em_residual_scan`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from sagecal_tpu.core.types import VisData
+from sagecal_tpu.solvers.lm import LMConfig, _residual_rows, lm_solve
+from sagecal_tpu.solvers.robust import update_w_and_nu
+from sagecal_tpu.solvers.sage import (
+    ClusterData,
+    _res_norm,
+    em_residual_scan,
+    predict_full_model,
+)
+
+
+class AdmmLocalResult(NamedTuple):
+    p: jax.Array  # (M, nchunk_max, 8N)
+    res_0: jax.Array
+    res_1: jax.Array
+
+
+def admm_sagefit(
+    data: VisData,
+    cdata: ClusterData,
+    p0: jax.Array,
+    Y: jax.Array,
+    BZ: jax.Array,
+    rho: jax.Array,
+    max_emiter: int = 1,
+    lm_config: LMConfig = LMConfig(),
+    robust_nu: Optional[float] = None,
+) -> AdmmLocalResult:
+    """One worker's ADMM x-update for one tile.
+
+    Args:
+      p0, Y, BZ: (M, nchunk_max, 8N) real — current solution, scaled
+        Lagrange multipliers, and consensus target B_f Z (the same BZ is
+        applied to every hybrid chunk of a cluster, as in
+        rtr_solve_robust_admm).
+      rho: (M,) per-cluster penalties (already fratio-scaled by the
+        caller, sagecal_master.cpp:709-723).
+      robust_nu: optional Student's-t nu — when given, each cluster solve
+        is IRLS-weighted by w = (nu+1)/(nu+e^2) from the residual at the
+        incoming solution (the robust ADMM path's E-step).
+    """
+    rows, F = data.vis.shape[0], data.vis.shape[1]
+    nreal = rows * F * 8
+
+    full0 = predict_full_model(p0, cdata, data)
+    res_0 = _res_norm(data.vis - full0, data.mask, nreal)
+
+    mask8 = jnp.repeat(data.mask, 8, axis=-1) if robust_nu is not None else None
+
+    def solve_one(xeff, coh_k, cmap_k, p_k, extras_k):
+        y_k, bz_k, rho_k = extras_k
+        if robust_nu is not None:
+            ed = _residual_rows(
+                p_k, coh_k, xeff, data.mask, data.ant_p, data.ant_q, cmap_k, None
+            )
+            sqrt_w, _ = update_w_and_nu(
+                ed, jnp.asarray(robust_nu, p_k.dtype), mask=mask8
+            )
+        else:
+            sqrt_w = None
+        res = lm_solve(
+            xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
+            lm_config, sqrt_weights=sqrt_w,
+            admm_y=y_k, admm_bz=bz_k, admm_rho=rho_k,
+        )
+        return res.p, None
+
+    p = p0
+    for _ in range(max_emiter):
+        p, _ = em_residual_scan(data, cdata, p, (Y, BZ, rho), solve_one)
+
+    full1 = predict_full_model(p, cdata, data)
+    res_1 = _res_norm(data.vis - full1, data.mask, nreal)
+    return AdmmLocalResult(p=p, res_0=res_0, res_1=res_1)
+
+
+def admm_dual_update(Y, p, BZ, rho):
+    """Y <- Y + rho (J - BZ) (sagecal_slave.cpp:831): the scaled dual
+    ascent step.  Shapes (M, nchunk_max, 8N); rho (M,)."""
+    return Y + rho[:, None, None] * (p - BZ)

@@ -1,0 +1,160 @@
+"""Consensus-ADMM polynomial math: frequency-smoothness constraints.
+
+TPU-first re-design of ``/root/reference/src/lib/Dirac/consensus_poly.c``.
+The reference runs this on the MPI master as pthread loops over clusters;
+here every routine is a pure jitted array op, batched over clusters, and
+the frequency sums that the master accumulated from worker messages
+become ``lax.psum`` terms on a ``freq`` mesh axis (see
+:mod:`sagecal_tpu.parallel.mesh`).
+
+Conventions:
+  B: (Nf, Npoly) real basis matrix, row f = basis evaluated at freqs[f]
+     (the reference stores B column-major Npoly x Nf, consensus_poly.c:39).
+  Z: (M, Npoly, K) global consensus variable; K = 8N (or 8N realified
+     params of any shape).  The constraint is J_f ~ sum_p B[f,p] Z[:,p].
+  rho: (Nf, M) per-frequency, per-cluster regularization.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# polynomial types (consensus_poly.c:21-28)
+POLY_ORDINARY = 0
+POLY_NORMALIZED = 1
+POLY_BERNSTEIN = 2
+POLY_RATIONAL = 3  # [1, (f-f0)/f0, (f0/f-1), ...]
+
+
+def setup_polynomials(freqs, f0: float, Npoly: int, ptype: int = POLY_BERNSTEIN):
+    """Basis matrix B (Nf, Npoly).  Mirrors ``setup_polynomials``
+    (consensus_poly.c:39-186) including the Bernstein min/max frequency
+    normalization and the odd/even split of the rational type-3 basis."""
+    freqs = np.asarray(freqs, np.float64)
+    Nf = freqs.shape[0]
+    B = np.zeros((Nf, Npoly))
+    if ptype in (POLY_ORDINARY, POLY_NORMALIZED):
+        frat = (freqs - f0) / f0
+        B[:, 0] = 1.0
+        for p in range(1, Npoly):
+            B[:, p] = B[:, p - 1] * frat
+        if ptype == POLY_NORMALIZED:
+            nrm = np.sqrt(np.sum(B**2, axis=0))
+            B = np.where(nrm[None, :] > 0, B / np.where(nrm == 0, 1, nrm)[None, :], 0.0)
+    elif ptype == POLY_BERNSTEIN:
+        fmax, fmin = freqs.max(), freqs.min()
+        x = (freqs - fmin) / max(fmax - fmin, 1e-300)
+        n = Npoly - 1
+        from math import comb
+
+        for p in range(Npoly):
+            B[:, p] = comb(n, p) * x**p * (1.0 - x) ** (n - p)
+    elif ptype == POLY_RATIONAL:
+        B[:, 0] = 1.0
+        frat = (freqs - f0) / f0
+        last = frat.copy()
+        for p in range(1, Npoly, 2):
+            B[:, p] = last
+            last = last * frat
+        frat = f0 / freqs - 1.0
+        last = frat.copy()
+        for p in range(2, Npoly, 2):
+            B[:, p] = last
+            last = last * frat
+    else:
+        raise ValueError(f"unknown polynomial type {ptype}")
+    return jnp.asarray(B)
+
+
+def find_prod_inverse(B, fratio=None):
+    """pinv(sum_f w_f B_f B_f^T): (Npoly, Npoly).  ``find_prod_inverse``
+    (consensus_poly.c:196): weights are the per-frequency unflagged-data
+    ratios."""
+    Nf = B.shape[0]
+    w = jnp.ones((Nf,), B.dtype) if fratio is None else jnp.asarray(fratio)
+    P = jnp.einsum("f,fp,fq->pq", w, B, B)
+    return jnp.linalg.pinv(P)
+
+
+def find_prod_inverse_full(B, rho, alpha=None):
+    """Per-cluster pinv(sum_f rho[f,m] B_f B_f^T [+ alpha_m I]): (M, Npoly,
+    Npoly).  ``find_prod_inverse_full[_fed]`` (consensus_poly.c:465,547);
+    the federated variant's alpha*I ties local to global Z."""
+    P = jnp.einsum("fm,fp,fq->mpq", rho, B, B)
+    if alpha is not None:
+        Np = B.shape[1]
+        P = P + alpha[:, None, None] * jnp.eye(Np, dtype=B.dtype)[None]
+    return jnp.linalg.pinv(P)
+
+
+def accumulate_z_term(B_f, Yrho_f):
+    """One frequency's additive contribution to the z right-hand side:
+    outer(B_f, Y_f + rho_f J_f).
+
+    B_f: (Npoly,) this frequency's basis row; Yrho_f: (M, K).
+    Returns (M, Npoly, K).  The master's accumulation loop
+    (sagecal_master.cpp:841-852) — on a mesh this is followed by
+    ``lax.psum`` over the freq axis.
+    """
+    return B_f[None, :, None] * Yrho_f[:, None, :]
+
+
+def update_global_z(z, Bii):
+    """Z = Bii applied along the Npoly axis of z: (M, Npoly, K).
+
+    ``update_global_z_multi`` (consensus_poly.c:778): per cluster,
+    Z_m = Bii_m @ z_m (Bii symmetric).
+    """
+    return jnp.einsum("mpq,mqk->mpk", Bii, z)
+
+
+def bz_for_freq(Z, B_f):
+    """The per-frequency consensus target B_f Z: (M, K) from Z (M, Npoly, K).
+    What the master sends each worker per ADMM iteration
+    (sagecal_master.cpp:770-800)."""
+    return jnp.einsum("p,mpk->mk", B_f, Z)
+
+
+def update_rho_bb(rho, rho_upper, dY, dJ, eps: float = 1e-12):
+    """Barzilai-Borwein adaptive penalty update, per cluster.
+
+    ``update_rho_bb`` (consensus_poly.c:860-911): with deltaY = Yhat -
+    Yhat_old and deltaJ = J - J_old per cluster, compute the spectral
+    steps alphaSD = <dY,dY>/<dY,dJ>, alphaMG = <dY,dJ>/<dJ,dJ>, pick
+    alphaMG if 2*alphaMG > alphaSD else alphaSD - alphaMG/2, and accept
+    only under sufficient correlation (>0.2) and 0.001 < alpha < upper.
+
+    rho, rho_upper: (M,); dY, dJ: (M, K) per-cluster flattened deltas.
+    """
+    ip12 = jnp.sum(dY * dJ, axis=-1)
+    ip11 = jnp.sum(dY * dY, axis=-1)
+    ip22 = jnp.sum(dJ * dJ, axis=-1)
+    safe12 = jnp.where(jnp.abs(ip12) < eps, 1.0, ip12)
+    corr = ip12 / jnp.sqrt(jnp.maximum(ip11 * ip22, eps))
+    alphaSD = ip11 / safe12
+    alphaMG = ip12 / jnp.where(ip22 < eps, 1.0, ip22)
+    alphahat = jnp.where(2.0 * alphaMG > alphaSD, alphaMG, alphaSD - 0.5 * alphaMG)
+    ok = (
+        (ip12 > eps)
+        & (ip11 > eps)
+        & (ip22 > eps)
+        & (corr > 0.2)
+        & (alphahat > 1e-3)
+        & (alphahat < rho_upper)
+    )
+    return jnp.where(ok, alphahat, rho)
+
+
+def soft_threshold(z, lam):
+    """Elementwise soft threshold (``soft_threshold_z``,
+    consensus_poly.c:1044)."""
+    return jnp.sign(z) * jnp.maximum(jnp.abs(z) - lam, 0.0)
+
+
+def admm_dual_residual(Z_new, Z_old):
+    """Per-real-parameter dual residual ||Z_old - Z_new||/sqrt(size)
+    (sagecal_master.cpp:878-885)."""
+    d = (Z_new - Z_old).ravel()
+    return jnp.linalg.norm(d) / jnp.sqrt(d.shape[0])

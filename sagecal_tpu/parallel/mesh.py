@@ -1,0 +1,201 @@
+"""Mesh-parallel consensus ADMM: frequencies on a device mesh axis.
+
+This replaces the reference's MPI master/worker star
+(``/root/reference/src/MPI/sagecal_master.cpp`` /
+``sagecal_slave.cpp``, p2p tags ``proto.h:24-59``) with a single SPMD
+program over a ``jax.sharding.Mesh``:
+
+- each device along the ``freq`` axis owns one sub-band's visibility
+  tile — the reference's "one MPI worker per group of MS";
+- the ADMM x-step (:func:`sagecal_tpu.parallel.admm.admm_sagefit`) runs
+  independently per shard;
+- the master's Z-update ``z = sum_f B_f (x) (Y_f + rho_f J_f)`` is a
+  ``lax.psum`` over the freq axis (sagecal_master.cpp:841-852 was a
+  recv+accumulate loop), and ``Bii = pinv(sum_f rho_f B_f B_f^T)`` is a
+  psum of small (Npoly, Npoly) terms followed by a replicated pinv;
+- the manifold-averaging alignment at the first iteration becomes an
+  ``all_gather`` of (M, N, 2, 2) Jones blocks (small) + replicated math.
+
+Iteration protocol (matches slave/master handshake order,
+sagecal_slave.cpp:727-895):
+  admm 0:  plain (unaugmented) solve; align J across frequencies on the
+           quotient manifold; Yhat = rho*J; z-step; Y = Yhat - rho*BZ.
+  admm>0:  augmented solve with (Y, BZ); Yhat = Y + rho*J; z-step with
+           the NEW J; dual update against the NEW consensus,
+           Y = Yhat - rho*BZ_new; optional Barzilai-Borwein rho update
+           every other iteration (consensus_poly.c:860-911, cadence at
+           sagecal_slave.cpp:899).
+
+Multi-host scaling: build the Mesh over ``jax.devices()`` spanning
+hosts (``jax.distributed.initialize``); the same psum/all_gather ride
+ICI inside a slice and DCN across — no code change, matching SURVEY.md
+section 5's mapping.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from sagecal_tpu.core.types import VisData, jones_to_params, params_to_jones
+from sagecal_tpu.parallel import consensus
+from sagecal_tpu.parallel.admm import admm_sagefit
+from sagecal_tpu.parallel.manifold import manifold_average
+from sagecal_tpu.solvers.lm import LMConfig
+from sagecal_tpu.solvers.sage import ClusterData
+
+
+class AdmmResult(NamedTuple):
+    p: jax.Array  # (Nf, M, nchunk_max, 8N) per-band solutions
+    Y: jax.Array  # (Nf, M, nchunk_max, 8N) duals
+    Z: jax.Array  # (M, Npoly, nchunk_max*8N) consensus variable
+    rho: jax.Array  # (Nf, M) final penalties
+    dual_res: jax.Array  # (nadmm,) dual residual trace
+    primal_res: jax.Array  # (nadmm,) mean primal residual ||J - BZ||
+
+
+def _flat(x):
+    return x.reshape(x.shape[:-2] + (-1,))
+
+
+def _unflat(x, nchunk, n8):
+    return x.reshape(x.shape[:-1] + (nchunk, n8))
+
+
+def _zstep(Yhat_flat, rho, B_f, axis_name, federated_alpha=None):
+    """psum z accumulation + replicated Bii + Z update.  Yhat_flat (M, K)."""
+    z = jax.lax.psum(consensus.accumulate_z_term(B_f, Yhat_flat), axis_name)
+    P_term = jnp.einsum("m,p,q->mpq", rho, B_f, B_f)
+    P_sum = jax.lax.psum(P_term, axis_name)
+    if federated_alpha is not None:
+        Np = B_f.shape[0]
+        P_sum = P_sum + federated_alpha[:, None, None] * jnp.eye(Np, dtype=P_sum.dtype)[None]
+    Bii = jnp.linalg.pinv(P_sum)
+    return consensus.update_global_z(z, Bii)
+
+
+def make_admm_mesh_fn(
+    mesh: Mesh,
+    nadmm: int,
+    axis_name: str = "freq",
+    max_emiter: int = 1,
+    plain_emiter: int = 2,
+    lm_config: LMConfig = LMConfig(),
+    use_manifold_align: bool = True,
+    bb_rho: bool = False,
+    rho_upper: float = 1e3,
+):
+    """Build the jitted mesh-wide ADMM calibration function.
+
+    The returned fn takes leading-axis-``Nf`` stacks (sharded over the
+    ``freq`` mesh axis):
+      fn(data_stack: VisData pytree with (Nf, ...) leaves,
+         cdata_stack: ClusterData pytree (Nf, ...),
+         p0: (Nf, M, nchunk_max, 8N), rho: (Nf, M), B: (Nf, Npoly))
+    and returns an :class:`AdmmResult`.  The whole Nadmm loop runs in one
+    jit/shard_map program.
+    """
+
+    def local_loop(data: VisData, cdata: ClusterData, p0, rho, B_f):
+        M, nchunk_max, n8 = p0.shape
+        zeros = jnp.zeros_like(p0)
+
+        # ---- admm 0: plain solve (sagecal_slave.cpp:727 sagefit) -------
+        r0 = admm_sagefit(
+            data, cdata, p0, zeros, zeros, jnp.zeros_like(rho),
+            max_emiter=plain_emiter, lm_config=lm_config,
+        )
+        p = r0.p
+        if use_manifold_align:
+            # master-side unitary-ambiguity fix (sagecal_master.cpp:826-838)
+            jones = params_to_jones(p)  # (M, nchunk, N, 2, 2)
+            gath = jax.lax.all_gather(jones, axis_name)  # (Nf, M, nchunk, N, 2, 2)
+            Nf = gath.shape[0]
+            gflat = gath.reshape(Nf, M, -1, 2, 2)
+            aligned = manifold_average(gflat, niter=20)
+            idx = jax.lax.axis_index(axis_name)
+            p = jones_to_params(aligned[idx].reshape(jones.shape)).astype(p0.dtype)
+
+        Yhat = rho[:, None, None] * p  # Y=0 so Yhat = rho*J
+        Z = _zstep(_flat(Yhat), rho, B_f, axis_name)
+        BZ = _unflat(consensus.bz_for_freq(Z, B_f), nchunk_max, n8)
+        Y = Yhat - rho[:, None, None] * BZ
+
+        # ---- admm > 0 ---------------------------------------------------
+        def one_iter(carry, it):
+            p, Y, Z, rho, Yhat_prev, p_prev = carry
+            BZ = _unflat(consensus.bz_for_freq(Z, B_f), nchunk_max, n8)
+            loc = admm_sagefit(
+                data, cdata, p, Y, BZ, rho,
+                max_emiter=max_emiter, lm_config=lm_config,
+            )
+            p1 = loc.p
+            Yhat = Y + rho[:, None, None] * p1
+            Z1 = _zstep(_flat(Yhat), rho, B_f, axis_name)
+            BZ1 = _unflat(consensus.bz_for_freq(Z1, B_f), nchunk_max, n8)
+            Y1 = Yhat - rho[:, None, None] * BZ1
+            dres = consensus.admm_dual_residual(Z1, Z)
+            pr = _flat(p1 - BZ1)
+            pres = jax.lax.pmean(
+                jnp.linalg.norm(pr.ravel()) / jnp.sqrt(pr.size), axis_name
+            )
+            if bb_rho:
+                dY = _flat(Yhat) - _flat(Yhat_prev)
+                dJ = _flat(p1) - _flat(p_prev)
+                rho_new = consensus.update_rho_bb(
+                    rho, jnp.full_like(rho, rho_upper), dY, dJ
+                )
+                # BB cadence: update every other iteration
+                # (sagecal_slave.cpp:899)
+                rho1 = jnp.where(it % 2 == 0, rho_new, rho)
+            else:
+                rho1 = rho
+            return (p1, Y1, Z1, rho1, Yhat, p1), (dres, pres)
+
+        init = (p, Y, Z, rho, Yhat, p)
+        (p, Y, Z, rho, _, _), (dres, pres) = jax.lax.scan(
+            one_iter, init, jnp.arange(1, nadmm)
+        )
+        dres = jnp.concatenate([jnp.zeros((1,), dres.dtype), dres])
+        pres = jnp.concatenate([jnp.zeros((1,), pres.dtype), pres])
+        return p[None], Y[None], Z, rho[None], dres, pres
+
+    fspec = P(axis_name)
+    rspec = P()
+
+    ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+
+    @jax.jit
+    def fn(data_stack, cdata_stack, p0, rho, B):
+        if p0.shape[0] != ndev:
+            raise ValueError(
+                f"leading (sub-band) axis {p0.shape[0]} != mesh size {ndev}; "
+                "data multiplexing (more sub-bands than devices) is not yet "
+                "supported — group sub-bands per device first"
+            )
+        sm = jax.shard_map(
+            lambda d, c, p, r, b: local_loop(
+                jax.tree_util.tree_map(lambda x: x[0], d),
+                jax.tree_util.tree_map(lambda x: x[0], c),
+                p[0], r[0], b[0],
+            ),
+            mesh=mesh,
+            in_specs=(fspec, fspec, fspec, fspec, fspec),
+            out_specs=(fspec, fspec, rspec, fspec, rspec, rspec),
+            check_vma=False,
+        )
+        p, Y, Z, rho_f, dres, pres = sm(data_stack, cdata_stack, p0, rho, B)
+        return AdmmResult(p=p, Y=Y, Z=Z, rho=rho_f, dual_res=dres, primal_res=pres)
+
+    return fn
+
+
+def stack_for_mesh(items):
+    """Stack a list of per-frequency pytrees on a new leading axis for
+    sharding over the ``freq`` mesh axis.  Static (non-pytree) fields
+    must be identical across items."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *items)

@@ -158,12 +158,22 @@ def lm_solve(
     config: LMConfig = LMConfig(),
     sqrt_weights: Optional[jax.Array] = None,
     itmax_dynamic: Optional[jax.Array] = None,
+    admm_y: Optional[jax.Array] = None,
+    admm_bz: Optional[jax.Array] = None,
+    admm_rho: Optional[jax.Array] = None,
 ) -> LMResult:
     """Solve min_p sum_rows ||vis - J_p C J_q^H||^2 per hybrid chunk.
 
     ``itmax_dynamic``: optional traced iteration bound (the SAGE driver's
     weighted per-cluster iteration allocation, lmfit.c:859-882);
     ``config.itmax`` stays the static compile-time ceiling.
+
+    ADMM augmentation (``admm_y/admm_bz`` (nchunk, 8N), ``admm_rho``
+    scalar): adds ``y^T(p - bz) + rho/2 ||p - bz||^2`` to the cost — the
+    consensus-constrained local solve of ``sagefit_visibilities_admm``
+    (admm_solve.c:221; cost contract Dirac.h:1182-1195).  The augmented
+    term is exactly quadratic, so it enters the normal equations as
+    ``JTJ += rho I`` and ``JTe -= y + rho (p - bz)``.
 
     Args:
       vis: (rows, F, 2, 2) complex effective data for this cluster.
@@ -177,8 +187,31 @@ def lm_solve(
     """
     nchunk = p0.shape[0]
     args = (coh, vis, mask, ant_p, ant_q, chunk_map, nchunk, sqrt_weights)
+    with_admm = admm_y is not None
+    if with_admm:
+        rho = jnp.asarray(admm_rho, p0.dtype)
+
+        def aug_cost(p, c):
+            d = p - admm_bz
+            return c + jnp.sum(admm_y * d, axis=-1) + 0.5 * rho * jnp.sum(d * d, axis=-1)
+
+        # JTe carries the HALF-gradient convention (grad of sum(e*e) is
+        # -2*JTe), so the augmented terms enter at half strength too:
+        # gradient 0.5*y + 0.5*rho*(p-bz), Hessian 0.5*rho*I — exactly the
+        # reference's factors (rtr_solve_robust_admm.c:680-689,941-942).
+        def aug_grad(p):
+            return 0.5 * (admm_y + rho * (p - admm_bz))
+
+    else:
+
+        def aug_cost(p, c):
+            return c
+
+        def aug_grad(p):
+            return jnp.zeros_like(p)
 
     JTJ, JTe, cost0 = _assemble_normal_eq(p0, *args)
+    cost0 = aug_cost(p0, cost0)
     # mu_0 = tau * max(diag(JTJ)) per chunk (levmar init)
     diag0 = jnp.diagonal(JTJ, axis1=-2, axis2=-1)
     mu0 = config.tau * jnp.max(diag0, axis=-1)
@@ -196,16 +229,18 @@ def lm_solve(
     def body(st):
         it, p, cost, mu, nu, done = st
         JTJ, JTe, _ = _assemble_normal_eq(p, *args)
+        JTe = JTe - aug_grad(p)
         n8 = p.shape[-1]
-        A = JTJ + mu[:, None, None] * jnp.eye(n8, dtype=p.dtype)[None]
+        damp = mu + 0.5 * rho if with_admm else mu
+        A = JTJ + damp[:, None, None] * jnp.eye(n8, dtype=p.dtype)[None]
         dp = _solve_spd(A, JTe)
         pnew = p + dp
-        cost_new = _cost_only(pnew, *args)
-        # gain ratio rho = (cost - cost_new) / (dp.(mu*dp + JTe))
+        cost_new = aug_cost(pnew, _cost_only(pnew, *args))
+        # gain ratio (cost - cost_new) / (dp.(mu*dp + JTe))
         denom = jnp.sum(dp * (mu[:, None] * dp + JTe), axis=-1)
-        rho = (cost - cost_new) / jnp.where(denom == 0.0, 1e-30, denom)
-        accept = (rho > 0.0) & jnp.isfinite(cost_new) & (~done)
-        fac = jnp.maximum(1.0 / 3.0, 1.0 - (2.0 * rho - 1.0) ** 3)
+        gain = (cost - cost_new) / jnp.where(denom == 0.0, 1e-30, denom)
+        accept = (gain > 0.0) & jnp.isfinite(cost_new) & (~done)
+        fac = jnp.maximum(1.0 / 3.0, 1.0 - (2.0 * gain - 1.0) ** 3)
         mu_acc = mu * fac
         mu_rej = mu * nu
         p1 = jnp.where(accept[:, None], pnew, p)

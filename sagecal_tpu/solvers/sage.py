@@ -114,21 +114,52 @@ def build_cluster_data(
     )
 
 
+def cluster_model(p_k, coh_k, cmap_k, ant_p, ant_q):
+    """One cluster's corrupted model J_p C J_q^H: (rows, F, 2, 2).
+
+    p_k: (nchunk, 8N); coh_k: (rows, F, 2, 2); cmap_k: (rows,)."""
+    jones = params_to_jones(p_k)
+    jp = jones[cmap_k, ant_p]
+    jq = jones[cmap_k, ant_q]
+    return jp[:, None] @ coh_k @ jnp.conj(jnp.swapaxes(jq, -1, -2))[:, None]
+
+
 def predict_full_model(p_all, cdata: ClusterData, data: VisData):
     """sum_k J C J^H over all clusters (``minimize_viz_full_pth``,
     lmfit.c:692)."""
 
     def one(carry, inp):
         coh_k, cmap_k, p_k = inp
-        jones = params_to_jones(p_k)  # (nchunk_max, N, 2, 2)
-        jp = jones[cmap_k, data.ant_p]
-        jq = jones[cmap_k, data.ant_q]
-        model = jp[:, None] @ coh_k @ jnp.conj(jnp.swapaxes(jq, -1, -2))[:, None]
-        return carry + model, None
+        return carry + cluster_model(p_k, coh_k, cmap_k, data.ant_p, data.ant_q), None
 
     init = jnp.zeros_like(data.vis)
     total, _ = jax.lax.scan(one, init, (cdata.coh, cdata.chunk_map, p_all))
     return total
+
+
+def em_residual_scan(data: VisData, cdata: ClusterData, p_all, extras, solve_one):
+    """One SAGE expectation pass: scan clusters with the residual as carry
+    (the add-back / solve / subtract structure of lmfit.c:876-986).
+
+    ``solve_one(xeff, coh_k, cmap_k, p_k, extras_k) -> (p_new_k, aux_k)``
+    runs the per-cluster maximization against ``xeff`` = residual with
+    this cluster's current model restored.  ``extras``: pytree of arrays
+    with leading cluster axis (or None).  Returns (p_new (M,...), aux).
+    """
+
+    def cluster_step(xres, inp):
+        coh_k, cmap_k, p_k, extras_k = inp
+        model_old = cluster_model(p_k, coh_k, cmap_k, data.ant_p, data.ant_q)
+        xeff = xres + model_old
+        p_new, aux = solve_one(xeff, coh_k, cmap_k, p_k, extras_k)
+        model_new = cluster_model(p_new, coh_k, cmap_k, data.ant_p, data.ant_q)
+        return xeff - model_new, (p_new, aux)
+
+    xres0 = data.vis - predict_full_model(p_all, cdata, data)
+    _, (p_new, aux) = jax.lax.scan(
+        cluster_step, xres0, (cdata.coh, cdata.chunk_map, p_all, extras)
+    )
+    return p_new, aux
 
 
 def _res_norm(res, mask, nreal):
@@ -163,7 +194,7 @@ def sagefit(
     res_0 = _res_norm(res_vis0, data.mask, nreal)
 
     def em_iteration(p_all, nerr, weighted, em_idx, key):
-        """One EM pass: scan over clusters, residual as carry."""
+        """One EM pass over clusters via :func:`em_residual_scan`."""
         last_em = em_idx == config.max_emiter - 1
         use_robust = robust and last_em
         # OS acceleration on non-final EM passes (lmfit.c:906-934); the
@@ -173,34 +204,27 @@ def sagefit(
             mode in (SM_OSLM_LBFGS, SM_RLM_RLBFGS, SM_OSLM_OSRLM_RLBFGS)
             and not last_em
         )
+        key, sub = jax.random.split(key)
+        subkeys = jax.random.split(sub, M)
 
-        def cluster_step(carry, inp):
-            xres, key = carry
-            coh_k, cmap_k, p_k, nerr_k, nchunk_k = inp
-            key, sub = jax.random.split(key)
-            # add this cluster's current model back (lmfit.c:890)
-            jones = params_to_jones(p_k)
-            jp = jones[cmap_k, data.ant_p]
-            jq = jones[cmap_k, data.ant_q]
-            model_old = jp[:, None] @ coh_k @ jnp.conj(jnp.swapaxes(jq, -1, -2))[:, None]
-            xeff = xres + model_old
+        def solve_one(xeff, coh_k, cmap_k, p_k, extras_k):
+            nerr_k, key_k = extras_k
             itermax = jnp.where(
                 weighted,
                 (0.20 * nerr_k * total_iter).astype(jnp.int32) + iter_bar,
                 config.max_iter,
             )
             if use_robust:
-                res, _nu = robust_lm_solve(
+                res, nu_k = robust_lm_solve(
                     xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
                     nu0=config.nulow, nulow=config.nulow, nuhigh=config.nuhigh,
                     em_iters=config.em_rounds_robust,
                     config=LMConfig(itmax=config.max_iter),
                 )
-                nu_k = _nu
             elif use_os:
                 res = os_lm_solve(
                     xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
-                    lmcfg, nsubsets=2, key=sub,
+                    lmcfg, nsubsets=2, key=key_k,
                 )
                 nu_k = jnp.asarray(config.nulow, p_all.dtype)
             else:
@@ -213,17 +237,10 @@ def sagefit(
             c0 = jnp.sum(res.cost0)
             c1 = jnp.sum(res.cost)
             nerr_new = jnp.where(c0 > 0.0, jnp.maximum((c0 - c1) / c0, 0.0), 0.0)
-            # subtract updated model (lmfit.c:980)
-            jones1 = params_to_jones(res.p)
-            jp1 = jones1[cmap_k, data.ant_p]
-            jq1 = jones1[cmap_k, data.ant_q]
-            model_new = jp1[:, None] @ coh_k @ jnp.conj(jnp.swapaxes(jq1, -1, -2))[:, None]
-            return (xeff - model_new, key), (res.p, nerr_new, nu_k)
+            return res.p, (nerr_new, nu_k)
 
-        (xres_final, key), (p_new, nerr_new, nus) = jax.lax.scan(
-            cluster_step,
-            (data.vis - predict_full_model(p_all, cdata, data), key),
-            (cdata.coh, cdata.chunk_map, p_all, nerr, cdata.nchunk),
+        p_new, (nerr_new, nus) = em_residual_scan(
+            data, cdata, p_all, (nerr, subkeys), solve_one
         )
         total = jnp.sum(nerr_new)
         nerr_norm = jnp.where(total > 0.0, nerr_new / total, nerr_new)

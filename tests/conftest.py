@@ -19,8 +19,16 @@ import jax  # noqa: E402
 # The axon sitecustomize force-selects the TPU backend via
 # jax.config.update("jax_platforms", "axon,cpu"); undo it for hermetic tests.
 jax.config.update("jax_platforms", "cpu")
+# The reference CPU path is double precision throughout (SURVEY.md hard
+# part (c)); tests validate the f64 semantics on CPU while f32/bf16 is
+# the TPU production dtype.
+jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running end-to-end test")
 
 
 @pytest.fixture(scope="session")
