@@ -266,6 +266,8 @@ def predict_coherencies(
     fdelta: float = 0.0,
     source_chunk: int = 32,
     shapelets: Optional[ShapeletTable] = None,
+    tdelta: float = 0.0,
+    dec0: float = 0.0,
 ) -> jax.Array:
     """Sum of source coherencies on every baseline row: (rows, F, 2, 2) complex.
 
@@ -278,6 +280,9 @@ def predict_coherencies(
     ``shapelets``: mode table for ST_SHAPELET members.  NOTE: shapelet
     uv factors are evaluated at each channel's frequency, not the
     reference's freq0-only approximation (predict.c:200).
+
+    ``tdelta``/``dec0``: integration time (s) and field declination for
+    time smearing (``time_smear``, predict.c:93-107); 0 disables.
     """
     # skip the extended-source math entirely for pure point-source batches
     # (the overwhelmingly common case) when stype is concrete
@@ -299,12 +304,32 @@ def predict_coherencies(
     return _predict_coherencies(
         u, v, w, freqs, src, shapelets,
         float(fdelta), int(source_chunk), has_extended, has_shapelet,
+        float(tdelta), float(dec0),
     )
 
 
-@functools.partial(jax.jit, static_argnums=(6, 7, 8, 9))
+def time_smear_factor(ll, mm, dec0, tdelta, u, v, w, freqs):
+    """EW-array time-smearing attenuation (predict.c:93-107):
+    1.0645*erf(0.8326*prod)/prod, prod = omega_E * tdelta * |b|_lambda *
+    sqrt(l^2 + (sin(dec0) m)^2).  Shapes: u,v,w (rows,), ll,mm (S,),
+    freqs (F,) -> (F, rows, S)."""
+    from jax.scipy.special import erf
+
+    bl = jnp.sqrt(u * u + v * v + w * w)  # seconds
+    ds = jnp.sin(dec0) * mm
+    r1 = jnp.sqrt(ll * ll + ds * ds)  # (S,)
+    prod = (
+        7.2921150e-5 * tdelta
+        * freqs[:, None, None] * bl[None, :, None] * r1[None, None, :]
+    )
+    safe = jnp.maximum(prod, 1e-30)
+    return jnp.where(prod > 1e-12, 1.0645 * erf(0.8326 * safe) / safe, 1.0)
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7, 8, 9, 10, 11))
 def _predict_coherencies(
-    u, v, w, freqs, src, shapelets, fdelta, source_chunk, has_extended, has_shapelet
+    u, v, w, freqs, src, shapelets, fdelta, source_chunk, has_extended,
+    has_shapelet, tdelta, dec0,
 ):
     rows = u.shape[0]
     F = freqs.shape[0]
@@ -330,6 +355,10 @@ def _predict_coherencies(
         ang = freqs[:, None, None] * G[None]
         ph = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
         smear = sinc_abs(G * (0.5 * fdelta))[None]  # (1, rows, chunk)
+        if tdelta > 0.0:
+            smear = smear * time_smear_factor(
+                c.ll, c.mm, dec0, tdelta, u, v, w, freqs
+            )
         if has_extended:
             amp = (smear * _shape_factor(c, u, v, w, freqs)).astype(ph.real.dtype)
         else:

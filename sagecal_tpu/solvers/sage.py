@@ -193,13 +193,17 @@ def sagefit(
     res_vis0 = data.vis - full0
     res_0 = _res_norm(res_vis0, data.mask, nreal)
 
-    def em_iteration(p_all, nerr, weighted, em_idx, key):
+    def _nerr_of(res):
+        # relative cost decrease -> iteration weighting (lmfit.c:971-979)
+        c0 = jnp.sum(res.cost0)
+        c1 = jnp.sum(res.cost)
+        return jnp.where(c0 > 0.0, jnp.maximum((c0 - c1) / c0, 0.0), 0.0)
+
+    def em_iteration(p_all, nerr, nus_in, weighted, em_idx, key):
         """One EM pass over clusters via :func:`em_residual_scan`."""
         last_em = em_idx == config.max_emiter - 1
         use_robust = robust and last_em
-        # OS acceleration on non-final EM passes (lmfit.c:906-934); the
-        # RTR/NSD modes currently dispatch to LM pending the manifold
-        # solvers' integration here.
+        # OS acceleration on non-final EM passes (lmfit.c:906-934)
         use_os = (
             mode in (SM_OSLM_LBFGS, SM_RLM_RLBFGS, SM_OSLM_OSRLM_RLBFGS)
             and not last_em
@@ -208,12 +212,50 @@ def sagefit(
         subkeys = jax.random.split(sub, M)
 
         def solve_one(xeff, coh_k, cmap_k, p_k, extras_k):
-            nerr_k, key_k = extras_k
+            nerr_k, key_k, nu_prev = extras_k
             itermax = jnp.where(
                 weighted,
                 (0.20 * nerr_k * total_iter).astype(jnp.int32) + iter_bar,
                 config.max_iter,
             )
+            if mode == SM_RTR_OSLM_LBFGS:
+                # RTR every EM pass, weighted budget (lmfit.c:936:
+                # this_itermax+5 RSD, +10 TR)
+                from sagecal_tpu.solvers.rtr import RTRConfig, rtr_solve
+
+                res = rtr_solve(
+                    xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
+                    RTRConfig(itmax_rsd=config.max_iter + 5,
+                              itmax_rtr=config.max_iter + 10),
+                    itmax_dynamic=itermax,
+                )
+                return res.p, (_nerr_of(res), jnp.asarray(config.nulow, p_all.dtype))
+            if mode == SM_RTR_OSRLM_RLBFGS:
+                # nu carried across EM passes (lmfit.c:940-947 sets
+                # robust_nu only at ci==0 and lets it persist)
+                from sagecal_tpu.solvers.rtr import RTRConfig, rtr_solve_robust
+
+                res, nu_k = rtr_solve_robust(
+                    xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
+                    RTRConfig(itmax_rsd=config.max_iter + 5,
+                              itmax_rtr=config.max_iter + 10),
+                    nu0=nu_prev, nulow=config.nulow, nuhigh=config.nuhigh,
+                    em_iters=config.em_rounds_robust,
+                    itmax_dynamic=itermax,
+                )
+                return res.p, (_nerr_of(res), nu_k.astype(p_all.dtype))
+            if mode == SM_NSD_RLBFGS:
+                # robust NSD with nu estimation (rtr_solve_robust.c:2104)
+                from sagecal_tpu.solvers.rtr import nsd_solve_robust
+
+                res, nu_k = nsd_solve_robust(
+                    xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
+                    itmax=config.max_iter + 15,
+                    nu0=nu_prev, nulow=config.nulow, nuhigh=config.nuhigh,
+                    em_iters=config.em_rounds_robust,
+                    itmax_dynamic=itermax,
+                )
+                return res.p, (_nerr_of(res), nu_k.astype(p_all.dtype))
             if use_robust:
                 res, nu_k = robust_lm_solve(
                     xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
@@ -233,14 +275,10 @@ def sagefit(
                     lmcfg, itmax_dynamic=itermax,
                 )
                 nu_k = jnp.asarray(config.nulow, p_all.dtype)
-            # relative cost decrease -> iteration weighting (lmfit.c:971-979)
-            c0 = jnp.sum(res.cost0)
-            c1 = jnp.sum(res.cost)
-            nerr_new = jnp.where(c0 > 0.0, jnp.maximum((c0 - c1) / c0, 0.0), 0.0)
-            return res.p, (nerr_new, nu_k)
+            return res.p, (_nerr_of(res), nu_k)
 
         p_new, (nerr_new, nus) = em_residual_scan(
-            data, cdata, p_all, (nerr, subkeys), solve_one
+            data, cdata, p_all, (nerr, subkeys, nus_in), solve_one
         )
         total = jnp.sum(nerr_new)
         nerr_norm = jnp.where(total > 0.0, nerr_new / total, nerr_new)
@@ -251,7 +289,7 @@ def sagefit(
     weighted = jnp.asarray(False)
     nus = jnp.full((M,), config.nulow, p0.dtype)
     for em in range(config.max_emiter):
-        p, nerr, nus, key = em_iteration(p, nerr, weighted, em, key)
+        p, nerr, nus, key = em_iteration(p, nerr, nus, weighted, em, key)
         if config.randomize:
             weighted = ~weighted
     mean_nu = jnp.clip(jnp.mean(nus), config.nulow, config.nuhigh)

@@ -1,0 +1,205 @@
+"""Beam model tests: array-factor oracles, element E-Jones properties,
+beam-aware predict consistency."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from sagecal_tpu.ops.beam import (
+    DOBEAM_ARRAY,
+    DOBEAM_FULL,
+    STAT_SINGLE,
+    BeamPointing,
+    ElementCoeffs,
+    StationGeometry,
+    array_beam_gain,
+    azel_grid,
+    beam_jones,
+    element_ejones,
+    eval_element,
+    predict_coherencies_withbeam,
+    synthetic_dipole_coeffs,
+)
+from sagecal_tpu.ops.rime import point_source_batch, predict_coherencies
+from sagecal_tpu.ops import transforms
+
+
+def _geometry(N=3, K=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return StationGeometry(
+        longitude=jnp.asarray(rng.uniform(0.1, 0.2, N)),
+        latitude=jnp.asarray(rng.uniform(0.8, 0.9, N)),
+        x=jnp.asarray(rng.uniform(-20, 20, (N, K))),
+        y=jnp.asarray(rng.uniform(-20, 20, (N, K))),
+        z=jnp.asarray(rng.uniform(-0.5, 0.5, (N, K))),
+        elem_mask=jnp.ones((N, K)),
+        bf_type=STAT_SINGLE,
+    )
+
+
+class TestArrayBeam:
+    def test_unit_gain_at_beam_center(self):
+        """Pointing at the beam center with f == f0 makes every element
+        phase zero -> gain exactly 1."""
+        geom = _geometry()
+        ra0, dec0 = 0.4, 0.7
+        pointing = BeamPointing(ra0, dec0, ra0, dec0, 150e6)
+        t_jd = np.array([2456789.3])
+        az, el = azel_grid(
+            np.array([ra0]), np.array([dec0]),
+            np.asarray(geom.longitude), np.asarray(geom.latitude), t_jd,
+        )
+        g = array_beam_gain(
+            geom, pointing,
+            jnp.asarray(az), jnp.asarray(el),
+            jnp.asarray(az[..., 0]), jnp.asarray(el[..., 0]),
+            jnp.asarray(az[..., 0]), jnp.asarray(el[..., 0]),
+            jnp.asarray([150e6]),
+        )
+        if float(el.min()) >= 0:
+            np.testing.assert_allclose(np.asarray(g), 1.0, rtol=1e-10)
+
+    def test_gain_below_one_off_center(self):
+        geom = _geometry()
+        ra0, dec0 = 0.4, 0.7
+        pointing = BeamPointing(ra0, dec0, ra0, dec0, 150e6)
+        t_jd = np.array([2456789.3])
+        src_ra = np.array([ra0 + 0.3])
+        src_dec = np.array([dec0 - 0.2])
+        az, el = azel_grid(src_ra, src_dec, np.asarray(geom.longitude),
+                           np.asarray(geom.latitude), t_jd)
+        az0, el0 = azel_grid(np.array([ra0]), np.array([dec0]),
+                             np.asarray(geom.longitude),
+                             np.asarray(geom.latitude), t_jd)
+        g = array_beam_gain(
+            geom, pointing, jnp.asarray(az), jnp.asarray(el),
+            jnp.asarray(az0[..., 0]), jnp.asarray(el0[..., 0]),
+            jnp.asarray(az0[..., 0]), jnp.asarray(el0[..., 0]),
+            jnp.asarray([150e6]),
+        )
+        assert np.all(np.asarray(g) <= 1.0 + 1e-12)
+        assert np.all(np.asarray(g) < 1.0)
+
+    def test_below_horizon_zero(self):
+        geom = _geometry()
+        pointing = BeamPointing(0.4, 0.7, 0.4, 0.7, 150e6)
+        az = jnp.zeros((1, 3, 1))
+        el = jnp.full((1, 3, 1), -0.1)
+        g = array_beam_gain(
+            geom, pointing, az, el,
+            jnp.zeros((1, 3)), jnp.full((1, 3), 0.5),
+            jnp.zeros((1, 3)), jnp.full((1, 3), 0.5),
+            jnp.asarray([150e6]),
+        )
+        np.testing.assert_allclose(np.asarray(g), 0.0)
+
+
+class TestElementBeam:
+    def test_mode_count(self):
+        assert ElementCoeffs.mode_count(1) == 1
+        assert ElementCoeffs.mode_count(2) == 3  # n=0: 1; n=1: m=-1,1
+        assert ElementCoeffs.mode_count(3) == 6  # + n=2: m=-2,0,2
+
+    def test_single_mode_is_gaussian_taper(self):
+        """With only the (0,0) mode and preamble 1, the pattern is
+        exp(-r^2/(2 beta^2)) independent of theta."""
+        c = ElementCoeffs(
+            pattern_theta=jnp.asarray([1.0 + 0j]),
+            pattern_phi=jnp.asarray([1.0 + 0j]),
+            preamble=jnp.asarray([1.0]),
+            beta=0.8, M=1,
+        )
+        r = jnp.asarray([0.0, 0.3, 1.0])
+        th = jnp.asarray([0.0, 1.0, 2.0])
+        phi_v, theta_v = eval_element(c, r, th)
+        expect = np.exp(-0.5 * (np.asarray(r) / 0.8) ** 2)
+        np.testing.assert_allclose(np.asarray(phi_v), expect, rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(theta_v), expect, rtol=1e-12)
+
+    def test_ejones_zero_below_horizon(self):
+        c = synthetic_dipole_coeffs()
+        E = element_ejones(c, jnp.asarray([0.5]), jnp.asarray([-0.2]))
+        np.testing.assert_allclose(np.asarray(E), 0.0)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        c = synthetic_dipole_coeffs(M=3, beta=0.9)
+        p = str(tmp_path / "coeff.npz")
+        c.save(p)
+        c2 = ElementCoeffs.load(p)
+        np.testing.assert_allclose(
+            np.asarray(c2.pattern_theta), np.asarray(c.pattern_theta)
+        )
+        assert c2.M == c.M and c2.beta == c.beta
+
+
+class TestBeamPredict:
+    def test_identity_beam_matches_plain_predict(self):
+        """B = identity for every (t,f,station,source) must reproduce the
+        unbeamed coherencies exactly."""
+        rng = np.random.default_rng(3)
+        rows, T, F, N, S = 12, 2, 2, 4, 3
+        u = jnp.asarray(rng.uniform(-1e-6, 1e-6, rows))
+        v = jnp.asarray(rng.uniform(-1e-6, 1e-6, rows))
+        w = jnp.asarray(rng.uniform(-1e-7, 1e-7, rows))
+        freqs = jnp.asarray([140e6, 160e6])
+        src = point_source_batch(
+            rng.uniform(-0.02, 0.02, S), rng.uniform(-0.02, 0.02, S),
+            rng.uniform(0.5, 2.0, S),
+        )
+        time_idx = jnp.asarray(rng.integers(0, T, rows), jnp.int32)
+        ant_p = jnp.asarray(rng.integers(0, N, rows), jnp.int32)
+        ant_q = jnp.asarray((rng.integers(1, N, rows) + np.asarray(ant_p)) % N,
+                            jnp.int32)
+        B = jnp.broadcast_to(
+            jnp.eye(2, dtype=jnp.complex64), (T, F, N, S, 2, 2)
+        )
+        out = predict_coherencies_withbeam(
+            u, v, w, freqs, src, B, time_idx, ant_p, ant_q
+        )
+        ref = predict_coherencies(u, v, w, freqs, src)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_scalar_beam_scales_flux(self):
+        """A constant scalar beam g on every station scales each source's
+        coherency by g^2."""
+        rng = np.random.default_rng(4)
+        rows, T, F, N, S = 8, 1, 1, 3, 2
+        u = jnp.asarray(rng.uniform(-1e-6, 1e-6, rows))
+        v = jnp.asarray(rng.uniform(-1e-6, 1e-6, rows))
+        w = jnp.zeros(rows)
+        freqs = jnp.asarray([150e6])
+        src = point_source_batch([0.0, 0.01], [0.0, -0.01], [1.0, 2.0])
+        time_idx = jnp.zeros(rows, jnp.int32)
+        ant_p = jnp.asarray(rng.integers(0, N, rows), jnp.int32)
+        ant_q = jnp.asarray((np.asarray(ant_p) + 1) % N, jnp.int32)
+        g = 0.7
+        B = g * jnp.broadcast_to(jnp.eye(2, dtype=jnp.complex64),
+                                 (T, F, N, S, 2, 2))
+        out = predict_coherencies_withbeam(
+            u, v, w, freqs, src, B, time_idx, ant_p, ant_q
+        )
+        ref = predict_coherencies(u, v, w, freqs, src)
+        np.testing.assert_allclose(
+            np.asarray(out), g * g * np.asarray(ref), atol=1e-5
+        )
+
+    def test_full_beam_jones_pipeline(self):
+        """beam_jones + beam predict run end-to-end and attenuate
+        off-center sources relative to the center."""
+        geom = _geometry(N=4, K=16, seed=1)
+        ra0, dec0 = 0.4, 0.75
+        pointing = BeamPointing(ra0, dec0, ra0, dec0, 150e6)
+        coeff = synthetic_dipole_coeffs()
+        t_jd = np.array([2456789.3, 2456789.3001])
+        ra = np.array([ra0, ra0 + 0.25])
+        dec = np.array([dec0, dec0 - 0.15])
+        freqs = np.asarray([150e6])
+        B = beam_jones(geom, pointing, coeff, ra, dec, t_jd, jnp.asarray(freqs),
+                       mode=DOBEAM_FULL)
+        assert B.shape == (2, 1, 4, 2, 2, 2)
+        Bn = np.abs(np.asarray(B))
+        # array factor at center = 1, element taper <= 1: center source
+        # gain >= off-center gain
+        assert np.all(Bn[:, :, :, 0].max(axis=(-1, -2))
+                      >= Bn[:, :, :, 1].max(axis=(-1, -2)) - 1e-9)

@@ -1,0 +1,110 @@
+"""Residual / correction / simulation semantics tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from sagecal_tpu.core.types import herm, jones_to_params
+from sagecal_tpu.io.simulate import corrupt_and_observe, make_visdata, random_jones
+from sagecal_tpu.ops.residual import (
+    SIMUL_ADD,
+    SIMUL_ONLY,
+    SIMUL_SUB,
+    apply_correction,
+    calculate_residuals,
+    correction_jones,
+    mat_invert_reg,
+    simulate_visibilities,
+)
+from sagecal_tpu.ops.rime import point_source_batch
+from sagecal_tpu.solvers.sage import build_cluster_data
+
+
+def _setup(nstations=6, nclus=2):
+    data = make_visdata(nstations=nstations, tilesz=2, nchan=2, dtype=np.float64)
+    clusters = [
+        point_source_batch([0.0], [0.0], [2.0], dtype=jnp.float64),
+        point_source_batch([0.02], [-0.01], [1.0], dtype=jnp.float64),
+    ][:nclus]
+    jones = random_jones(nclus, nstations, seed=5, amp=0.2, dtype=np.complex128)
+    data = corrupt_and_observe(data, clusters, jones=jones, noise_sigma=0.0)
+    # simulate and predict with the SAME (zero) bandwidth-smearing term
+    cdata = build_cluster_data(data, clusters, [1] * nclus, fdelta=0.0)
+    p = jones_to_params(jones)[:, None, :]
+    return data, cdata, p, jones
+
+
+class TestMatInvert:
+    def test_unregularized_inverse(self):
+        rng = np.random.default_rng(0)
+        J = jnp.asarray(rng.standard_normal((4, 2, 2))
+                        + 1j * rng.standard_normal((4, 2, 2)))
+        inv = mat_invert_reg(J, 0.0)
+        eye = np.broadcast_to(np.eye(2), (4, 2, 2))
+        np.testing.assert_allclose(np.asarray(J @ inv), eye, atol=1e-10)
+
+    def test_rho_regularizes_singular(self):
+        J = jnp.zeros((1, 2, 2), jnp.complex128)
+        # a = 0.5 I, det = 0.25; sqrt|det| <= rho triggers the guard
+        # det += rho -> 0.75 (residual.c:176-178), so inv = (0.5/0.75) I
+        inv = mat_invert_reg(J, 0.5)
+        np.testing.assert_allclose(
+            np.asarray(inv[0]), (2.0 / 3.0) * np.eye(2), atol=1e-12
+        )
+
+
+class TestResiduals:
+    def test_exact_solution_gives_zero_residual(self):
+        data, cdata, p, _ = _setup()
+        res = calculate_residuals(data, cdata, p)
+        assert float(jnp.max(jnp.abs(res))) < 1e-10
+
+    def test_correction_restores_uncorrupted_single_cluster(self):
+        """One cluster, correction by its own solutions: the corrected
+        model must equal the bare coherencies J^-1 (J C J^H) J^-H = C."""
+        data, cdata, p, jones = _setup(nclus=1)
+        model = simulate_visibilities(data, cdata, p, mode=SIMUL_ONLY,
+                                      ccid_index=0, rho=0.0)
+        np.testing.assert_allclose(
+            np.asarray(model), np.asarray(cdata.coh[0]), atol=1e-9
+        )
+
+    def test_phase_only_correction_is_unit_modulus(self):
+        data, cdata, p, jones = _setup(nclus=1)
+        pinv = correction_jones(p[0], rho=0.0, phase_only=True)
+        d = np.asarray(pinv)
+        np.testing.assert_allclose(np.abs(d[..., 0, 0]), 1.0, rtol=1e-10)
+        np.testing.assert_allclose(np.abs(d[..., 1, 1]), 1.0, rtol=1e-10)
+        np.testing.assert_allclose(d[..., 0, 1], 0.0, atol=1e-12)
+
+
+class TestSimulate:
+    def test_modes(self):
+        data, cdata, p, _ = _setup()
+        model = simulate_visibilities(data, cdata, p, mode=SIMUL_ONLY)
+        added = simulate_visibilities(data, cdata, p, mode=SIMUL_ADD)
+        subbed = simulate_visibilities(data, cdata, p, mode=SIMUL_SUB)
+        np.testing.assert_allclose(
+            np.asarray(added), np.asarray(data.vis + model), atol=1e-12
+        )
+        np.testing.assert_allclose(
+            np.asarray(subbed), np.asarray(data.vis - model), atol=1e-12
+        )
+        # data was built as exactly this model: subtraction -> 0
+        assert float(jnp.max(jnp.abs(subbed))) < 1e-10
+
+    def test_ignore_clusters(self):
+        data, cdata, p, jones = _setup()
+        only1 = simulate_visibilities(data, cdata, p, mode=SIMUL_ONLY,
+                                      ignore_clusters=[0])
+        from sagecal_tpu.solvers.sage import cluster_model
+
+        m1 = cluster_model(p[1], cdata.coh[1], cdata.chunk_map[1],
+                           data.ant_p, data.ant_q)
+        np.testing.assert_allclose(np.asarray(only1), np.asarray(m1), atol=1e-10)
+
+    def test_uncorrupted_predict(self):
+        data, cdata, p, _ = _setup()
+        bare = simulate_visibilities(data, cdata, None, mode=SIMUL_ONLY)
+        np.testing.assert_allclose(
+            np.asarray(bare), np.asarray(cdata.coh.sum(0)), atol=1e-10
+        )
