@@ -1,0 +1,2 @@
+"""Application layer: calibration mode drivers + CLI (the role of
+``/root/reference/src/MS``)."""

@@ -1,0 +1,123 @@
+"""``sagecal-tpu`` command line: the reference ``sagecal`` flag surface
+(``/root/reference/src/MS/main.cpp:43-264``) on the TPU framework.
+
+Mode dispatch mirrors main.cpp:295-307: ``-N``>0 with ``-A``>0 and
+``-w``>1 -> minibatch-consensus; ``-N``>0 -> minibatch; else fullbatch.
+The input is a vis.h5 dataset (convert an MS with
+``python -m sagecal_tpu.apps.cli convert <ms> <h5>`` where casacore is
+available).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from sagecal_tpu.apps.config import RunConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="sagecal-tpu",
+        description="Direction-dependent radio interferometric calibration "
+        "on TPU (SAGECal capability set).",
+    )
+    ap.add_argument("-d", "--dataset", required=False, default="",
+                    help="input vis.h5 dataset (ref: -d MS)")
+    ap.add_argument("-s", "--sky", default="", help="sky model file (LSM)")
+    ap.add_argument("-c", "--clusters", default="",
+                    help="cluster file (defaults to <sky>.cluster)")
+    ap.add_argument("-p", "--solutions", default="solutions.txt",
+                    help="output solutions file")
+    ap.add_argument("-q", "--init-solutions", default=None,
+                    help="initial solutions (warm start)")
+    ap.add_argument("-t", "--tilesz", type=int, default=120)
+    ap.add_argument("-e", "--max-emiter", type=int, default=3)
+    ap.add_argument("-g", "--max-iter", type=int, default=2)
+    ap.add_argument("-l", "--max-lbfgs", type=int, default=10)
+    ap.add_argument("-m", "--lbfgs-m", type=int, default=7)
+    ap.add_argument("-j", "--solver-mode", type=int, default=3,
+                    help="0..6 per Dirac.h SM_* modes")
+    ap.add_argument("-x", "--min-uvcut", type=float, default=0.0)
+    ap.add_argument("-y", "--max-uvcut", type=float, default=1e20)
+    ap.add_argument("-L", "--nulow", type=float, default=2.0)
+    ap.add_argument("-H", "--nuhigh", type=float, default=30.0)
+    ap.add_argument("-R", "--no-randomize", action="store_true")
+    ap.add_argument("-W", "--whiten", action="store_true")
+    ap.add_argument("-a", "--simulate", type=int, default=0,
+                    help="1: model only, 2: add, 3: subtract")
+    ap.add_argument("-z", "--ignore-clusters", default=None)
+    ap.add_argument("-E", "--ccid", type=int, default=None,
+                    help="cluster id whose inverse corrects the residual")
+    ap.add_argument("--phase-only-correction", action="store_true")
+    ap.add_argument("-N", "--epochs", type=int, default=0)
+    ap.add_argument("-M", "--minibatches", type=int, default=1)
+    ap.add_argument("-w", "--bands", type=int, default=1)
+    ap.add_argument("-A", "--admm-iters", type=int, default=0)
+    ap.add_argument("-P", "--npoly", type=int, default=2)
+    ap.add_argument("-Q", "--poly-type", type=int, default=2)
+    ap.add_argument("-r", "--admm-rho", type=float, default=5.0)
+    ap.add_argument("--f32", action="store_true",
+                    help="solve in float32 (TPU-native precision)")
+    ap.add_argument("-V", "--verbose", action="store_true")
+    return ap
+
+
+def config_from_args(args) -> RunConfig:
+    return RunConfig(
+        dataset=args.dataset,
+        sky_model=args.sky,
+        cluster_file=args.clusters or (args.sky + ".cluster"),
+        out_solutions=args.solutions,
+        init_solutions=args.init_solutions,
+        tilesz=args.tilesz,
+        max_emiter=args.max_emiter,
+        max_iter=args.max_iter,
+        max_lbfgs=args.max_lbfgs,
+        lbfgs_m=args.lbfgs_m,
+        solver_mode=args.solver_mode,
+        nulow=args.nulow,
+        nuhigh=args.nuhigh,
+        randomize=not args.no_randomize,
+        min_uvcut=args.min_uvcut,
+        max_uvcut=args.max_uvcut,
+        whiten=args.whiten,
+        simulation_mode=args.simulate,
+        ignore_clusters_file=args.ignore_clusters,
+        ccid=args.ccid,
+        phase_only_correction=args.phase_only_correction,
+        epochs=args.epochs,
+        minibatches=args.minibatches,
+        bands=args.bands,
+        admm_iters=args.admm_iters,
+        npoly=args.npoly,
+        poly_type=args.poly_type,
+        admm_rho=args.admm_rho,
+        use_f64=not args.f32,
+        verbose=args.verbose,
+    )
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "convert":
+        from sagecal_tpu.io.dataset import ms_to_h5
+
+        ms_to_h5(argv[1], argv[2])
+        return 0
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    # mode dispatch (main.cpp:295-307)
+    if cfg.epochs > 0:
+        from sagecal_tpu.apps.minibatch import run_minibatch
+
+        run_minibatch(cfg)
+    else:
+        from sagecal_tpu.apps.fullbatch import run_fullbatch
+
+        run_fullbatch(cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""Run configuration: the framework's typed replacement for the
+reference's ``namespace Data`` mutable option globals
+(``/root/reference/src/MS/data.h:140-211``, defaults data.cpp:60-130).
+Field names follow the reference's single-letter flags (see cli.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from sagecal_tpu.solvers.sage import SM_OSLM_OSRLM_RLBFGS
+
+
+@dataclasses.dataclass
+class RunConfig:
+    # data / sky
+    dataset: str = ""  # -d
+    sky_model: str = ""  # -s
+    cluster_file: str = ""  # -F is format in ref; here explicit path
+    out_solutions: str = "solutions.txt"  # -p
+    init_solutions: Optional[str] = None  # -q warm start
+    tilesz: int = 120  # -t
+    # solver (defaults per user_manual.rst:32-58 / data.cpp)
+    max_emiter: int = 3  # -e
+    max_iter: int = 2  # -g
+    max_lbfgs: int = 10  # -l
+    lbfgs_m: int = 7  # -m
+    solver_mode: int = SM_OSLM_OSRLM_RLBFGS  # -j
+    nulow: float = 2.0
+    nuhigh: float = 30.0
+    randomize: bool = True  # -R
+    min_uvcut: float = 0.0  # -x
+    max_uvcut: float = 1e20  # -y
+    whiten: bool = False  # -W
+    # simulation (-a) / correction (-E)
+    simulation_mode: int = 0  # 0 calibrate; 1/2/3 = SIMUL_ONLY/ADD/SUB
+    ignore_clusters_file: Optional[str] = None  # -z
+    ccid: Optional[int] = None  # -E cluster id to correct residuals by
+    correction_rho: float = 1e-9
+    phase_only_correction: bool = False
+    # stochastic modes
+    epochs: int = 0  # -N  (>0 selects minibatch mode)
+    minibatches: int = 1  # -M
+    bands: int = 1  # -w mini-bands
+    admm_iters: int = 0  # -A (>0 with bands>1 selects consensus)
+    npoly: int = 2  # -P
+    poly_type: int = 2  # -Q (POLY_* in parallel.consensus)
+    admm_rho: float = 5.0  # -r
+    # divergence guard (fullbatch_mode.cpp:250,618-632)
+    res_ratio: float = 5.0
+    # precision
+    use_f64: bool = True
+    verbose: bool = False  # -V

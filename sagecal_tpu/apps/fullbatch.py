@@ -1,0 +1,168 @@
+"""Fullbatch calibration driver: the ``sagecal`` main path.
+
+Redesign of ``run_fullbatch_calibration``
+(``/root/reference/src/MS/fullbatch_mode.cpp:38-656``): per-tile loop of
+load -> precalculate coherencies -> SAGE solve -> write solutions ->
+residuals -> divergence guard.  The pthread/GPU pipeline orchestration
+of the reference dissolves into jitted solver calls; the host side only
+streams tiles and files.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from sagecal_tpu.apps.config import RunConfig
+from sagecal_tpu.core.types import identity_jones, jones_to_params, params_to_jones
+from sagecal_tpu.io import solutions as solio
+from sagecal_tpu.io.dataset import VisDataset
+from sagecal_tpu.io.skymodel import load_sky
+from sagecal_tpu.ops.residual import calculate_residuals, simulate_visibilities
+from sagecal_tpu.solvers.robust import whiten_uv_weights
+from sagecal_tpu.solvers.sage import SageConfig, build_cluster_data, sagefit
+
+
+def _load_ignore_list(path: Optional[str], cdefs) -> list:
+    if not path:
+        return []
+    with open(path) as f:
+        ids = {int(tok) for line in f for tok in line.split()
+               if not line.strip().startswith("#") and tok.strip()}
+    return [i for i, cd in enumerate(cdefs) if cd.cluster_id in ids]
+
+
+def _resolve_ccid(ccid: Optional[int], cdefs) -> Optional[int]:
+    """Reference cluster id (-E) -> cluster array index
+    (residual.c:953-960)."""
+    if ccid is None:
+        return None
+    for i, cd in enumerate(cdefs):
+        if cd.cluster_id == ccid:
+            return i
+    return None
+
+
+def run_fullbatch(cfg: RunConfig, log=print):
+    """Calibrate (or simulate) every tile of the dataset.  Returns the
+    per-tile (res_0, res_1) list."""
+    dtype = np.float64 if cfg.use_f64 else np.float32
+    cdtype = np.complex128 if cfg.use_f64 else np.complex64
+    ds = VisDataset(cfg.dataset, "r+")
+    meta = ds.meta
+    clusters, cdefs = load_sky(
+        cfg.sky_model, cfg.cluster_file, meta.ra0, meta.dec0, dtype=dtype
+    )
+    M = len(clusters)
+    nchunks = [cd.nchunk for cd in cdefs]
+    nchunk_max = max(nchunks)
+    N = meta.nstations
+    ignore_idx = _load_ignore_list(cfg.ignore_clusters_file, cdefs)
+    ccid_index = _resolve_ccid(cfg.ccid, cdefs)
+
+    # initial solutions: identity or warm start (-q),
+    # fullbatch_mode.cpp:206-237; simulation mode advances through the
+    # file's solution intervals per tile (fullbatch_mode.cpp:562)
+    jones_intervals = None
+    if cfg.init_solutions:
+        _, jones_intervals = solio.read_solutions(cfg.init_solutions)
+        p = jnp.asarray(
+            jones_to_params(jnp.asarray(jones_intervals[0], cdtype)).reshape(
+                M, nchunk_max, 8 * N
+            )
+        )
+    else:
+        eye = jones_to_params(identity_jones(N, cdtype))
+        p = jnp.broadcast_to(eye, (M, nchunk_max, 8 * N)).astype(dtype)
+    pinit = p
+
+    scfg = SageConfig(
+        max_emiter=cfg.max_emiter, max_iter=cfg.max_iter,
+        max_lbfgs=cfg.max_lbfgs, lbfgs_m=cfg.lbfgs_m,
+        solver_mode=cfg.solver_mode,
+        nulow=cfg.nulow, nuhigh=cfg.nuhigh, randomize=cfg.randomize,
+    )
+
+    sol_fh = None
+    if cfg.simulation_mode == 0:
+        sol_fh = open(cfg.out_solutions, "w")
+        solio.write_header(
+            sol_fh, meta.freq0, meta.deltaf, meta.deltat * cfg.tilesz / 60.0,
+            N, M, M * nchunk_max,
+        )
+
+    results = []
+    for tile_no, t0 in enumerate(ds.tiles(cfg.tilesz)):
+        tic = time.time()
+        full = ds.load_tile(
+            t0, cfg.tilesz, average_channels=False,
+            min_uvcut=cfg.min_uvcut, max_uvcut=cfg.max_uvcut, dtype=dtype,
+        )
+        cdata_full = build_cluster_data(
+            full, clusters, nchunks, fdelta=meta.deltaf / max(meta.nchan, 1)
+        )
+
+        if cfg.simulation_mode:
+            # predict / add / subtract (fullbatch_mode.cpp:536-591);
+            # corrupt with the tile's own solution interval
+            psim = None
+            if jones_intervals is not None:
+                ti = min(tile_no, jones_intervals.shape[0] - 1)
+                psim = jnp.asarray(
+                    jones_to_params(
+                        jnp.asarray(jones_intervals[ti], cdtype)
+                    ).reshape(M, nchunk_max, 8 * N)
+                )
+            out_vis = simulate_visibilities(
+                full, cdata_full, psim, mode=cfg.simulation_mode,
+                ignore_clusters=ignore_idx, ccid_index=ccid_index,
+                rho=cfg.correction_rho, phase_only=cfg.phase_only_correction,
+            )
+            ds.write_tile(t0, np.asarray(out_vis), column="model")
+            log(f"tile {t0}: simulated ({time.time()-tic:.1f}s)")
+            continue
+
+        data = ds.load_tile(
+            t0, cfg.tilesz, average_channels=True,
+            min_uvcut=cfg.min_uvcut, max_uvcut=cfg.max_uvcut, dtype=dtype,
+        )
+        if cfg.whiten:
+            wts = jnp.sqrt(whiten_uv_weights(data.u, data.v, meta.freq0))
+            data = data.replace(vis=data.vis * wts[:, None, None, None],
+                                mask=data.mask * (wts[:, None] > 0))
+        cdata = build_cluster_data(data, clusters, nchunks)
+
+        out = sagefit(data, cdata, p, scfg)
+        res0, res1 = float(out.res_0), float(out.res_1)
+        # divergence guard (fullbatch_mode.cpp:618-632)
+        diverged = (
+            not np.isfinite(res1) or res1 == 0.0 or res1 > cfg.res_ratio * res0
+        )
+        p = pinit if diverged else out.p
+        if diverged:
+            log(f"tile {t0}: diverged ({res0:.3e} -> {res1:.3e}), reset")
+
+        # append solution columns (fullbatch_mode.cpp:595-605)
+        jsol = np.asarray(params_to_jones(p)).reshape(M * nchunk_max, N, 2, 2)
+        solio.append_solutions(sol_fh, jsol)
+
+        # residuals on the full-channel data, optional correction
+        res = calculate_residuals(
+            full, cdata_full, p, ccid_index=ccid_index,
+            rho=cfg.correction_rho, phase_only=cfg.phase_only_correction,
+        )
+        ds.write_tile(t0, np.asarray(res), column="corrected")
+        log(
+            f"tile {t0}: residual {res0:.6f} -> {res1:.6f} "
+            f"nu {float(out.mean_nu):.1f} ({time.time()-tic:.1f}s)"
+        )
+        results.append((res0, res1))
+
+    if sol_fh:
+        sol_fh.close()
+    ds.close()
+    return results

@@ -131,7 +131,8 @@ def simulate_visibilities(
 
 
 def residual_norm(res: jax.Array, mask: jax.Array) -> jax.Array:
-    """||res||/n_real, the per-tile print (fullbatch_mode.cpp:636-643)."""
-    r = res * mask[..., None, None]
-    n = res.shape[0] * res.shape[1] * 8
-    return jnp.sqrt(jnp.sum(jnp.abs(r) ** 2)) / n
+    """||res||/n_real, the per-tile print (fullbatch_mode.cpp:636-643).
+    Delegates to the solver's bookkeeping so the two stay identical."""
+    from sagecal_tpu.solvers.sage import _res_norm
+
+    return _res_norm(res, mask, res.shape[0] * res.shape[1] * 8)

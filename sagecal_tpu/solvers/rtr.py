@@ -490,6 +490,11 @@ def rtr_solve_robust(
     (p, nu), (c0s, c1s) = jax.lax.scan(
         em, (p0, jnp.asarray(nu0, p0.dtype)), None, length=em_iters
     )
+    # re-estimate nu from the FINAL solution (the reference updates the
+    # weights/nu once more after the loop, rtr_solve_robust.c:1625)
+    _, nu = _robust_weights_and_nu(
+        vis, coh, mask, ant_p, ant_q, chunk_map, p, nu, nulow, nuhigh
+    )
     return RTRResult(p=p, cost0=c0s[0], cost=c1s[-1]), nu
 
 
@@ -518,5 +523,9 @@ def nsd_solve_robust(
 
     (p, nu), (c0s, c1s) = jax.lax.scan(
         em, (p0, jnp.asarray(nu0, p0.dtype)), None, length=em_iters
+    )
+    # final-solution nu re-estimate (rtr_solve_robust.c:2104)
+    _, nu = _robust_weights_and_nu(
+        vis, coh, mask, ant_p, ant_q, chunk_map, p, nu, nulow, nuhigh
     )
     return RTRResult(p=p, cost0=c0s[0], cost=c1s[-1]), nu

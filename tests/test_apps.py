@@ -1,0 +1,220 @@
+"""End-to-end application tests: dataset round trip, fullbatch CLI run,
+minibatch + band-consensus modes — the framework's version of the
+reference's dosage.sh fixture runs (test/Calibration/)."""
+
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sagecal_tpu.apps.cli import build_parser, config_from_args, main
+from sagecal_tpu.apps.config import RunConfig
+from sagecal_tpu.apps.fullbatch import run_fullbatch
+from sagecal_tpu.apps.minibatch import run_minibatch
+from sagecal_tpu.io import solutions as solio
+from sagecal_tpu.io.dataset import VisDataset, simulate_dataset
+from sagecal_tpu.io.simulate import random_jones
+from sagecal_tpu.ops.rime import point_source_batch
+
+
+SKY = """P1 0 0 0.0 51 0 0.0 2.0 0 0 0 0 0 0 0 0 0 0 150e6
+P2 0 2 0.0 50 30 0.0 1.0 0 0 0 0 0 0 0 0 0 0 150e6
+"""
+CLUSTER = "1 1 P1\n2 1 P2\n"
+
+
+@pytest.fixture()
+def workdir(tmp_path):
+    sky = tmp_path / "t.sky.txt"
+    sky.write_text(SKY)
+    (tmp_path / "t.sky.txt.cluster").write_text(CLUSTER)
+    return tmp_path
+
+
+def _make_dataset(path, nstations=7, ntime=4, nchan=2, jones=None, seed=0):
+    """Dataset whose sky matches SKY above (phase center ra=0, dec=51d)."""
+    from sagecal_tpu.io.skymodel import load_sky
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        skyf = os.path.join(td, "s.txt")
+        open(skyf, "w").write(SKY)
+        open(skyf + ".cluster", "w").write(CLUSTER)
+        clusters, _ = load_sky(skyf, skyf + ".cluster",
+                               0.0, math.radians(51.0), dtype=np.float64)
+    simulate_dataset(
+        str(path), nstations=nstations, ntime=ntime, nchan=nchan,
+        clusters=clusters, jones=jones, noise_sigma=1e-4, seed=seed,
+        dec0=math.radians(51.0),
+    )
+    # patch phase center attrs to match the sky model
+    import h5py
+
+    with h5py.File(str(path), "r+") as f:
+        f.attrs["ra0"] = 0.0
+        f.attrs["dec0"] = math.radians(51.0)
+    return clusters
+
+
+class TestDataset:
+    def test_roundtrip_and_averaging(self, tmp_path):
+        p = tmp_path / "d.h5"
+        jones = random_jones(2, 7, seed=1, amp=0.1, dtype=np.complex128)
+        _make_dataset(p, jones=jones)
+        with VisDataset(str(p)) as ds:
+            m = ds.meta
+            assert m.nstations == 7 and m.ntime == 4 and m.nchan == 2
+            tile = ds.load_tile(0, 2, average_channels=True)
+            assert tile.vis.shape == (2 * 21, 1, 2, 2)
+            full = ds.load_tile(0, 2, average_channels=False)
+            assert full.vis.shape == (2 * 21, 2, 2, 2)
+            # averaged == mean over channels (no flags)
+            np.testing.assert_allclose(
+                np.asarray(tile.vis[:, 0]),
+                np.asarray(full.vis).mean(axis=1),
+                rtol=1e-12,
+            )
+
+    def test_uvcut_masks_rows(self, tmp_path):
+        p = tmp_path / "d.h5"
+        _make_dataset(p)
+        with VisDataset(str(p)) as ds:
+            t_all = ds.load_tile(0, 2)
+            # median baseline length in wavelengths -> cut roughly half
+            from sagecal_tpu.core.types import C0
+
+            uvd = np.sqrt(np.asarray(t_all.u) ** 2 + np.asarray(t_all.v) ** 2)
+            cut = float(np.median(uvd)) * 150e6
+            t_cut = ds.load_tile(0, 2, min_uvcut=cut)
+            assert 0 < float(t_cut.mask.sum()) < float(t_all.mask.sum())
+
+    def test_write_tile_column(self, tmp_path):
+        p = tmp_path / "d.h5"
+        _make_dataset(p)
+        with VisDataset(str(p), "r+") as ds:
+            full = ds.load_tile(0, 2, average_channels=False)
+            ds.write_tile(0, np.asarray(full.vis) * 0.5, column="corrected")
+            import h5py
+
+            assert "corrected" in ds._f
+
+
+class TestFullbatchApp:
+    def test_calibrates_and_writes_solutions(self, workdir):
+        dsp = workdir / "d.h5"
+        jones = random_jones(2, 7, seed=3, amp=0.15, dtype=np.complex128)
+        _make_dataset(dsp, jones=jones)
+        cfg = RunConfig(
+            dataset=str(dsp), sky_model=str(workdir / "t.sky.txt"),
+            cluster_file=str(workdir / "t.sky.txt.cluster"),
+            out_solutions=str(workdir / "sol.txt"),
+            tilesz=4, max_emiter=2, max_iter=6, max_lbfgs=15,
+            solver_mode=1,
+        )
+        results = run_fullbatch(cfg, log=lambda *a: None)
+        assert len(results) == 1
+        r0, r1 = results[0]
+        assert r1 < 0.15 * r0, (r0, r1)
+        meta, jsol = solio.read_solutions(str(workdir / "sol.txt"))
+        assert jsol.shape == (1, 2, 7, 2, 2)
+        # residual column written
+        with VisDataset(str(dsp)) as ds:
+            import h5py
+
+            assert "corrected" in ds._f
+
+    def test_simulation_mode(self, workdir):
+        dsp = workdir / "d.h5"
+        _make_dataset(dsp, jones=None)
+        cfg = RunConfig(
+            dataset=str(dsp), sky_model=str(workdir / "t.sky.txt"),
+            cluster_file=str(workdir / "t.sky.txt.cluster"),
+            tilesz=4, simulation_mode=1,
+        )
+        run_fullbatch(cfg, log=lambda *a: None)
+        with VisDataset(str(dsp)) as ds:
+            assert "model" in ds._f
+            model = np.asarray(ds._f["model"])
+            vis = np.asarray(ds._f["vis"])
+            # dataset was built as the uncorrupted sky with tiny noise
+            rel = np.linalg.norm(model - vis) / np.linalg.norm(vis)
+            assert rel < 1e-2, rel
+
+    def test_divergence_guard_resets(self, workdir):
+        """With absurdly low res_ratio every tile 'diverges' and p stays
+        at the identity init -> solutions file holds identities."""
+        dsp = workdir / "d.h5"
+        jones = random_jones(2, 7, seed=3, amp=0.3, dtype=np.complex128)
+        _make_dataset(dsp, jones=jones)
+        cfg = RunConfig(
+            dataset=str(dsp), sky_model=str(workdir / "t.sky.txt"),
+            cluster_file=str(workdir / "t.sky.txt.cluster"),
+            out_solutions=str(workdir / "sol.txt"),
+            tilesz=4, max_emiter=1, max_iter=3, max_lbfgs=5,
+            res_ratio=1e-9,
+        )
+        run_fullbatch(cfg, log=lambda *a: None)
+        _, jsol = solio.read_solutions(str(workdir / "sol.txt"))
+        eye = np.broadcast_to(np.eye(2), jsol[0].shape)
+        np.testing.assert_allclose(jsol[0], eye, atol=1e-12)
+
+
+class TestMinibatchApp:
+    def test_bandpass_minibatch(self, workdir):
+        dsp = workdir / "d.h5"
+        jones = random_jones(2, 7, seed=4, amp=0.1, dtype=np.complex128)
+        _make_dataset(dsp, ntime=4, nchan=4, jones=jones)
+        cfg = RunConfig(
+            dataset=str(dsp), sky_model=str(workdir / "t.sky.txt"),
+            cluster_file=str(workdir / "t.sky.txt.cluster"),
+            out_solutions=str(workdir / "sol.txt"),
+            epochs=3, minibatches=2, bands=2,
+            max_lbfgs=12, lbfgs_m=5, solver_mode=1,
+        )
+        results = run_minibatch(cfg, log=lambda *a: None)
+        assert len(results) == 2
+        for r0, r1 in results:
+            assert r1 < 0.3 * r0, (r0, r1)
+
+    def test_band_consensus(self, workdir):
+        dsp = workdir / "d.h5"
+        jones = random_jones(2, 7, seed=5, amp=0.1, dtype=np.complex128)
+        _make_dataset(dsp, ntime=4, nchan=4, jones=jones)
+        cfg = RunConfig(
+            dataset=str(dsp), sky_model=str(workdir / "t.sky.txt"),
+            cluster_file=str(workdir / "t.sky.txt.cluster"),
+            out_solutions=str(workdir / "sol.txt"),
+            epochs=2, minibatches=1, bands=2, admm_iters=3,
+            npoly=2, poly_type=0, admm_rho=2.0,
+            max_lbfgs=12, lbfgs_m=5, solver_mode=1,
+        )
+        results = run_minibatch(cfg, log=lambda *a: None)
+        for r0, r1 in results:
+            assert r1 < 0.5 * r0, (r0, r1)
+
+
+class TestCLI:
+    def test_parser_roundtrip(self):
+        args = build_parser().parse_args(
+            ["-d", "x.h5", "-s", "sky.txt", "-t", "10", "-e", "4",
+             "-g", "2", "-l", "10", "-m", "7", "-j", "5", "-N", "2",
+             "-w", "3", "-A", "5"]
+        )
+        cfg = config_from_args(args)
+        assert cfg.tilesz == 10 and cfg.solver_mode == 5
+        assert cfg.epochs == 2 and cfg.bands == 3 and cfg.admm_iters == 5
+        assert cfg.cluster_file == "sky.txt.cluster"
+
+    def test_cli_fullbatch_run(self, workdir):
+        dsp = workdir / "d.h5"
+        jones = random_jones(2, 7, seed=6, amp=0.1, dtype=np.complex128)
+        _make_dataset(dsp, jones=jones)
+        rc = main([
+            "-d", str(dsp), "-s", str(workdir / "t.sky.txt"),
+            "-p", str(workdir / "sol.txt"),
+            "-t", "4", "-e", "2", "-g", "5", "-l", "10", "-j", "1",
+        ])
+        assert rc == 0
+        assert (workdir / "sol.txt").exists()
