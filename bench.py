@@ -1,49 +1,71 @@
 """Benchmark: joint LBFGS calibration throughput (north-star metric #1).
 
 Workload: 62-station LOFAR-like array, 100 source clusters, one tile of
-5 timeslots x 2 channels — the robust joint-LBFGS pass that closes every
-SAGE iteration (``lbfgs_fit_robust_wrapper``, /root/reference/src/lib/
-Dirac/lmfit.c:1019-1037), which is the dominant full-parameter solver
-in both the fullbatch and stochastic modes (BASELINE.md north-star:
-"LBFGS iters/sec/chip, 62-station, 100-cluster").
-
-Each LBFGS iteration evaluates the full 100-cluster RIME model
-(predict J C J^H summed over clusters) and its gradient by autodiff —
-the same work the reference does per iteration with threaded C kernels
-(robust_lbfgs.c:94,155).
+60 timeslots x 2 channels — the BASELINE.md north-star shape ("LBFGS
+iters/sec/chip, 62-station, 100-cluster"; graded config 1 uses -t 60).
+Each LBFGS iteration evaluates the full 100-cluster RIME model (predict
+J C J^H summed over clusters) and its gradient by autodiff — the same
+work the reference does per iteration with threaded C kernels
+(/root/reference/src/lib/Dirac/robust_lbfgs.c:94,155; the joint pass of
+lmfit.c:1019-1037).
 
 ``vs_baseline``: ratio against the same algorithm in float64 on the
 host CPU via the JAX CPU backend (the reference is CPU double +
 pthreads; no published numbers exist in the reference repo —
 BASELINE.md).  The CPU figure was measured on this machine and is
-pinned below so the driver run only measures the TPU.
+pinned below so the driver run only measures the TPU.  Set
+SAGECAL_BENCH_MEASURE_CPU=1 to re-measure it live in a subprocess.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Platform handling (round-2 fix): the axon sitecustomize force-selects
+the TPU platform, and a wedged axon tunnel HANGS backend init (verify
+skill gotchas 1 & 5).  main() probes the default backend in a
+throwaway subprocess with a timeout and falls back to the CPU platform
+— the benchmark always prints its JSON line, with a "platform" field
+saying what it actually ran on.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 # Measured 2026-07-29 on this container's CPU (JAX CPU backend, float64,
-# same workload as below, median of 3 runs after compile):
-#   python -c "import bench, numpy as np; print(bench.run(np.float64))"
-# with JAX_PLATFORMS=cpu and x64 enabled -> 0.407 iters/sec.
-CPU_BASELINE_ITERS_PER_SEC = 0.407
+# same workload/shape as below, single run after compile):
+#   python -c "import jax; jax.config.update('jax_platforms','cpu');
+#              jax.config.update('jax_enable_x64',True);
+#              import bench, numpy as np; print(bench.run(np.float64, repeats=1))"
+# pinned per workload shape (tilesz -> iters/sec, f64 CPU):
+#   60 = the north-star shape (BASELINE.md graded config 1, -t 60);
+#        measured 2026-07-29: 20 LBFGS iters in 1407 s -> 0.0142 it/s
+#    5 = the small shape used when falling back to the CPU platform
+#        (measured round 1: 0.407)
+_CPU_BASELINE_PINNED = {60: 0.0142, 5: 0.407}
 
 NSTATIONS = 62
 NCLUSTERS = 100
-TILESZ = 5
+TILESZ = 60
 NCHAN = 2
 LBFGS_ITERS = 20
 REPEATS = 3
 
+V5E_BF16_PEAK_FLOPS = 197e12  # TPU v5e per-chip peak (bf16)
 
-def build_workload(dtype=np.float32):
+
+from sagecal_tpu.utils.platform import (  # noqa: E402
+    cpu_device as _cpu_device,
+    probe_default_backend as _probe_default_backend,
+)
+
+
+def build_workload(dtype=np.float32, tilesz=TILESZ):
     """Synthesize the 62-stn/100-cluster tile.  MUST run on the CPU
     backend: eager complex ops and complex host<->device transfers are
-    unimplemented on the axon TPU backend (verify skill gotchas 3)."""
+    unimplemented on the axon TPU backend (verify skill gotcha 3)."""
     import jax.numpy as jnp
 
     from sagecal_tpu.core.types import jones_to_params
@@ -56,7 +78,7 @@ def build_workload(dtype=np.float32):
     fdt = jnp.float32 if dtype == np.float32 else jnp.float64
     cdt = np.complex64 if dtype == np.float32 else np.complex128
     data = make_visdata(
-        nstations=NSTATIONS, tilesz=TILESZ, nchan=NCHAN, freq0=f0, dtype=dtype
+        nstations=NSTATIONS, tilesz=tilesz, nchan=NCHAN, freq0=f0, dtype=dtype
     )
     ll = rng.uniform(-0.05, 0.05, NCLUSTERS)
     mm = rng.uniform(-0.05, 0.05, NCLUSTERS)
@@ -105,43 +127,112 @@ def make_step(data, cdata, nu=5.0):
     return step
 
 
-def run(dtype=np.float32):
+def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ):
     import jax
 
-    with jax.default_device(jax.devices("cpu")[0]):
-        data, cdata, p0 = build_workload(dtype)
+    with jax.default_device(_cpu_device()):
+        data, cdata, p0 = build_workload(dtype, tilesz)
     vis_ri = np.stack([np.asarray(data.vis.real), np.asarray(data.vis.imag)], -1)
     coh_ri = np.stack([np.asarray(cdata.coh.real), np.asarray(cdata.coh.imag)], -1)
     mask = np.asarray(data.mask)
     p0_h = np.asarray(p0)
     step = make_step(data, cdata)
     args = (vis_ri, mask, coh_ri, p0_h)
-    out = step(*args)  # compile + first run
+    flops = None
+    if want_flops:
+        # AOT-compile once and reuse the executable for the timing loop
+        # (calling the jit wrapper after .lower().compile() would trace
+        # and compile the identical program a second time)
+        try:
+            compiled = step.lower(*args).compile()
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            flops = float(cost.get("flops", 0.0)) or None
+            step = compiled
+        except Exception:
+            flops = None
+    out = step(*args)  # compile (if not AOT) + first run
     jax.block_until_ready(out)
     iters = int(np.asarray(out[2]))
     times = []
-    for _ in range(REPEATS):
+    for _ in range(repeats):
         t0 = time.perf_counter()
         out = step(*args)
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
     dt = float(np.median(times))
-    return max(iters, 1) / dt, iters
+    return max(iters, 1) / dt, iters, dt, flops
+
+
+def _measure_cpu_subprocess(tilesz=TILESZ, timeout=1800.0):
+    """Re-measure the CPU f64 baseline in a fresh process (optional)."""
+    code = (
+        "import jax, numpy as np; jax.config.update('jax_platforms','cpu');"
+        "jax.config.update('jax_enable_x64', True);"
+        f"import bench; v,i,dt,_ = bench.run(np.float64, repeats=1, tilesz={tilesz});"
+        "print('CPUBASE', v)"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout, capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("CPUBASE"):
+                return float(line.split()[1])
+    except Exception:
+        pass
+    return None
 
 
 def main():
-    value, iters = run(np.float32)
-    vs = value / CPU_BASELINE_ITERS_PER_SEC if CPU_BASELINE_ITERS_PER_SEC else None
-    print(
-        json.dumps(
-            {
-                "metric": "lbfgs_cal_iters_per_sec",
-                "value": round(value, 3),
-                "unit": "iter/s (62 stn, 100 clusters, 5 ts x 2 ch)",
-                "vs_baseline": round(vs, 3) if vs else None,
-            }
+    import jax
+
+    if not _probe_default_backend():
+        sys.stderr.write(
+            "bench: default (axon TPU) backend unavailable or wedged; "
+            "falling back to CPU platform\n"
         )
+        jax.config.update("jax_platforms", "cpu")
+
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:
+        jax.config.update("jax_platforms", "cpu")
+        platform = jax.devices()[0].platform
+
+    # north-star shape on the TPU; on the CPU-fallback path drop to the
+    # small tilesz-5 shape (the full shape takes tens of minutes per
+    # LBFGS solve on this single-core host) and compare against its own
+    # pinned baseline
+    on_tpu = platform not in ("cpu",)
+    tilesz = TILESZ if on_tpu else 5
+    repeats = REPEATS if on_tpu else 1
+    value, iters, dt, flops = run(
+        np.float32, repeats=repeats, want_flops=True, tilesz=tilesz
     )
+
+    cpu_measured = None
+    if os.environ.get("SAGECAL_BENCH_MEASURE_CPU"):
+        cpu_measured = _measure_cpu_subprocess(tilesz)
+    base = cpu_measured or _CPU_BASELINE_PINNED[tilesz]
+    vs = value / base if base else None
+
+    rec = {
+        "metric": "lbfgs_cal_iters_per_sec",
+        "value": round(value, 3),
+        "unit": f"iter/s (62 stn, 100 clusters, {tilesz} ts x {NCHAN} ch)",
+        "vs_baseline": round(vs, 3) if vs else None,
+        "platform": platform,
+        "cpu_baseline_iters_per_sec": base,
+        "cpu_baseline_source": "measured-live" if cpu_measured else "pinned",
+    }
+    if flops:
+        rec["tflops_per_sec"] = round(flops / dt / 1e12, 3)
+        rec["mfu_vs_v5e_bf16_peak"] = round(flops / dt / V5E_BF16_PEAK_FLOPS, 5)
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

@@ -44,6 +44,22 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-H", "--nuhigh", type=float, default=30.0)
     ap.add_argument("-R", "--no-randomize", action="store_true")
     ap.add_argument("-W", "--whiten", action="store_true")
+    ap.add_argument("-B", "--beam", type=int, default=0,
+                    help="beam model: 0 none, 1 array, 2 array+element, "
+                    "3 element, 4/5/6 same per-channel (ref DOBEAM codes)")
+    ap.add_argument("--element-coeffs", default=None,
+                    help="element-beam coefficient table file "
+                    "(default: built-in synthetic dipole)")
+    ap.add_argument("-b", "--per-channel", action="store_true",
+                    help="re-fit each channel after the averaged solve "
+                    "(ref -b doChan)")
+    ap.add_argument("-G", "--rho-file", default=None,
+                    help="per-cluster ADMM rho file (read_arho_fromfile "
+                    "format: cluster_id hybrid rho)")
+    ap.add_argument("-K", "--skip-tiles", type=int, default=0,
+                    help="skip this many solution tiles (partial rerun)")
+    ap.add_argument("-T", "--max-tiles", type=int, default=0,
+                    help="process at most this many tiles (0 = all)")
     ap.add_argument("-a", "--simulate", type=int, default=0,
                     help="1: model only, 2: add, 3: subtract")
     ap.add_argument("-z", "--ignore-clusters", default=None)
@@ -82,6 +98,12 @@ def config_from_args(args) -> RunConfig:
         min_uvcut=args.min_uvcut,
         max_uvcut=args.max_uvcut,
         whiten=args.whiten,
+        beam_mode=args.beam,
+        element_coeffs=args.element_coeffs,
+        per_channel=args.per_channel,
+        rho_file=args.rho_file,
+        skip_tiles=args.skip_tiles,
+        max_tiles=args.max_tiles,
         simulation_mode=args.simulate,
         ignore_clusters_file=args.ignore_clusters,
         ccid=args.ccid,

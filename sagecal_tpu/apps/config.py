@@ -46,6 +46,19 @@ class RunConfig:
     npoly: int = 2  # -P
     poly_type: int = 2  # -Q (POLY_* in parallel.consensus)
     admm_rho: float = 5.0  # -r
+    # beam (-B: 0 none, 1 array, 2 array+element, 3 element, 4/5/6 the
+    # same per-channel/wideband — main.cpp DOBEAM_* codes)
+    beam_mode: int = 0
+    element_coeffs: Optional[str] = None  # element-coefficient table file
+    # per-channel re-fit after the averaged solve (-b, doChan;
+    # fullbatch_mode.cpp:453-499)
+    per_channel: bool = False
+    # per-cluster ADMM rho / spatial alpha file (-G, read_arho_fromfile)
+    rho_file: Optional[str] = None
+    # partial reruns: skip first K tiles, process at most T tiles
+    # (-K/-T, MPI/main.cpp:133-139)
+    skip_tiles: int = 0
+    max_tiles: int = 0  # 0 = no limit
     # divergence guard (fullbatch_mode.cpp:250,618-632)
     res_ratio: float = 5.0
     # precision

@@ -24,7 +24,12 @@ from sagecal_tpu.io.dataset import VisDataset
 from sagecal_tpu.io.skymodel import load_sky
 from sagecal_tpu.ops.residual import calculate_residuals, simulate_visibilities
 from sagecal_tpu.solvers.robust import whiten_uv_weights
-from sagecal_tpu.solvers.sage import SageConfig, build_cluster_data, sagefit
+from sagecal_tpu.solvers.sage import (
+    SageConfig,
+    build_cluster_data,
+    build_cluster_data_withbeam,
+    sagefit,
+)
 
 
 def _load_ignore_list(path: Optional[str], cdefs) -> list:
@@ -47,6 +52,41 @@ def _resolve_ccid(ccid: Optional[int], cdefs) -> Optional[int]:
     return None
 
 
+_REF_BEAM_MODES = {
+    # reference -B codes (Dirac_common.h:120-140) -> (internal mode, wideband)
+    0: (0, False), 1: (1, False), 2: (3, False), 3: (2, False),
+    4: (1, True), 5: (3, True), 6: (2, True),
+}
+
+
+def _beam_setup(cfg: RunConfig, ds: VisDataset):
+    """Resolve -B: returns (geom, pointing, coeff, mode, wideband) or
+    None when beams are off (the doBeam dispatch of
+    fullbatch_mode.cpp:371-388)."""
+    if not cfg.beam_mode:
+        return None
+    from sagecal_tpu.ops.beam import (
+        DOBEAM_ARRAY, ElementCoeffs, synthetic_dipole_coeffs,
+    )
+
+    mode, wideband = _REF_BEAM_MODES[cfg.beam_mode]
+    bp = ds.load_beam()
+    if bp is None:
+        raise ValueError(
+            f"beam mode {cfg.beam_mode} requested but dataset "
+            f"{cfg.dataset} has no /beam group (station geometry)"
+        )
+    geom, pointing = bp
+    coeff = None
+    if mode != DOBEAM_ARRAY:
+        coeff = (
+            ElementCoeffs.load(cfg.element_coeffs)
+            if cfg.element_coeffs
+            else synthetic_dipole_coeffs()
+        )
+    return geom, pointing, coeff, mode, wideband
+
+
 def run_fullbatch(cfg: RunConfig, log=print):
     """Calibrate (or simulate) every tile of the dataset.  Returns the
     per-tile (res_0, res_1) list."""
@@ -63,6 +103,7 @@ def run_fullbatch(cfg: RunConfig, log=print):
     N = meta.nstations
     ignore_idx = _load_ignore_list(cfg.ignore_clusters_file, cdefs)
     ccid_index = _resolve_ccid(cfg.ccid, cdefs)
+    beam = _beam_setup(cfg, ds)
 
     # initial solutions: identity or warm start (-q),
     # fullbatch_mode.cpp:206-237; simulation mode advances through the
@@ -95,16 +136,33 @@ def run_fullbatch(cfg: RunConfig, log=print):
             N, M, M * nchunk_max,
         )
 
+    def _cdata(dat, t0, fdelta=None):
+        """Cluster coherencies, beam-aware when -B is on
+        (fullbatch_mode.cpp:371-388 dispatch)."""
+        if beam is None:
+            return build_cluster_data(dat, clusters, nchunks, fdelta=fdelta)
+        geom, pointing, coeff, mode, wideband = beam
+        return build_cluster_data_withbeam(
+            dat, clusters, nchunks, geom, pointing, coeff, mode,
+            ds.time_jd(t0, dat.tilesz), meta.ra0, meta.dec0,
+            fdelta=fdelta, wideband=wideband,
+        )
+
     results = []
+    ntiles_done = 0
     for tile_no, t0 in enumerate(ds.tiles(cfg.tilesz)):
+        # -K/-T partial reruns (MPI/main.cpp:133-139)
+        if tile_no < cfg.skip_tiles:
+            continue
+        if cfg.max_tiles and ntiles_done >= cfg.max_tiles:
+            break
+        ntiles_done += 1
         tic = time.time()
         full = ds.load_tile(
             t0, cfg.tilesz, average_channels=False,
             min_uvcut=cfg.min_uvcut, max_uvcut=cfg.max_uvcut, dtype=dtype,
         )
-        cdata_full = build_cluster_data(
-            full, clusters, nchunks, fdelta=meta.deltaf / max(meta.nchan, 1)
-        )
+        cdata_full = _cdata(full, t0, fdelta=meta.deltaf / max(meta.nchan, 1))
 
         if cfg.simulation_mode:
             # predict / add / subtract (fullbatch_mode.cpp:536-591);
@@ -134,7 +192,7 @@ def run_fullbatch(cfg: RunConfig, log=print):
             wts = jnp.sqrt(whiten_uv_weights(data.u, data.v, meta.freq0))
             data = data.replace(vis=data.vis * wts[:, None, None, None],
                                 mask=data.mask * (wts[:, None] > 0))
-        cdata = build_cluster_data(data, clusters, nchunks)
+        cdata = _cdata(data, t0)
 
         out = sagefit(data, cdata, p, scfg)
         res0, res1 = float(out.res_0), float(out.res_1)
@@ -150,11 +208,39 @@ def run_fullbatch(cfg: RunConfig, log=print):
         jsol = np.asarray(params_to_jones(p)).reshape(M * nchunk_max, N, 2, 2)
         solio.append_solutions(sol_fh, jsol)
 
-        # residuals on the full-channel data, optional correction
-        res = calculate_residuals(
-            full, cdata_full, p, ccid_index=ccid_index,
-            rho=cfg.correction_rho, phase_only=cfg.phase_only_correction,
-        )
+        if cfg.per_channel and meta.nchan > 1:
+            # -b: per-channel joint-LBFGS re-fit from the averaged
+            # solution, residuals per channel with each channel's own
+            # solution (fullbatch_mode.cpp:453-499 doChan path)
+            from sagecal_tpu.solvers.batchmode import bfgsfit_minibatch
+
+            res_np = np.empty(
+                (full.vis.shape[0], meta.nchan, 2, 2),
+                np.complex128 if cfg.use_f64 else np.complex64,
+            )
+            for c in range(meta.nchan):
+                dc = full.replace(
+                    vis=full.vis[:, c:c + 1],
+                    mask=full.mask[:, c:c + 1],
+                    freqs=full.freqs[c:c + 1],
+                )
+                cc = cdata_full._replace(coh=cdata_full.coh[:, :, c:c + 1])
+                p_c, _ = bfgsfit_minibatch(
+                    dc, cc, p, itmax=cfg.max_lbfgs, lbfgs_m=cfg.lbfgs_m,
+                )
+                res_c = calculate_residuals(
+                    dc, cc, p_c, ccid_index=ccid_index,
+                    rho=cfg.correction_rho,
+                    phase_only=cfg.phase_only_correction,
+                )
+                res_np[:, c] = np.asarray(res_c)[:, 0]
+            res = res_np
+        else:
+            # residuals on the full-channel data, optional correction
+            res = calculate_residuals(
+                full, cdata_full, p, ccid_index=ccid_index,
+                rho=cfg.correction_rho, phase_only=cfg.phase_only_correction,
+            )
         ds.write_tile(t0, np.asarray(res), column="corrected")
         log(
             f"tile {t0}: residual {res0:.6f} -> {res1:.6f} "

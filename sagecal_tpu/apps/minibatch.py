@@ -83,7 +83,16 @@ def run_minibatch(cfg: RunConfig, log=print):
         B = consensus.setup_polynomials(
             bfreqs, meta.freq0, cfg.npoly, cfg.poly_type
         )
-        rho = jnp.full((len(bands), M), cfg.admm_rho, dtype)
+        if cfg.rho_file:
+            # -G per-cluster regularization (read_arho_fromfile)
+            from sagecal_tpu.io.skymodel import read_cluster_rho
+
+            rho_m, _ = read_cluster_rho(cfg.rho_file, cdefs)
+            rho = jnp.broadcast_to(
+                jnp.asarray(rho_m, dtype), (len(bands), M)
+            )
+        else:
+            rho = jnp.full((len(bands), M), cfg.admm_rho, dtype)
         Bii = consensus.find_prod_inverse_full(
             jnp.asarray(B, dtype), rho
         )
@@ -175,21 +184,31 @@ def run_minibatch(cfg: RunConfig, log=print):
             log(f"epoch {epoch} minibatch {mb}: "
                 f"({time.time()-tic:.1f}s)")
 
-    # final residuals per band (minibatch_mode.cpp final epoch)
-    results = []
-    full = ds.load_tile(0, meta.ntime, average_channels=False, dtype=dtype)
+    # final residuals per band (minibatch_mode.cpp final epoch), streamed
+    # tile-by-tile with the same time edges as the training loop — the
+    # reference streams per tile; loading the whole observation at once
+    # would defeat the tile-streaming design for realistic sizes
     fd = meta.deltaf / max(meta.nchan, 1)
-    res_all = np.array(np.asarray(full.vis), copy=True)
-    for bi, (c0, c1) in enumerate(bands):
-        db = _band_visdata(full, c0, c1)
-        cb = build_cluster_data(db, clusters, nchunks, fdelta=fd)
-        res = calculate_residuals(db, cb, p_bands[bi])
-        res_all[:, c0:c1] = np.asarray(res)
-        r0 = float(jnp.linalg.norm(db.vis.ravel()))
-        r1 = float(jnp.linalg.norm(res.ravel()))
+    acc = [[0.0, 0.0] for _ in bands]  # per band: [sum|vis|^2, sum|res|^2]
+    for mb in range(nb):
+        t0, t1 = int(tedges[mb]), int(tedges[mb + 1])
+        if t1 <= t0:
+            continue
+        full = ds.load_tile(t0, t1 - t0, average_channels=False, dtype=dtype)
+        res_all = np.array(np.asarray(full.vis), copy=True)
+        for bi, (c0, c1) in enumerate(bands):
+            db = _band_visdata(full, c0, c1)
+            cb = build_cluster_data(db, clusters, nchunks, fdelta=fd)
+            res = calculate_residuals(db, cb, p_bands[bi])
+            res_all[:, c0:c1] = np.asarray(res)
+            acc[bi][0] += float(jnp.sum(jnp.abs(db.vis) ** 2))
+            acc[bi][1] += float(jnp.sum(jnp.abs(res) ** 2))
+        ds.write_tile(t0, res_all, column="corrected")
+    results = []
+    for bi in range(len(bands)):
+        r0, r1 = float(np.sqrt(acc[bi][0])), float(np.sqrt(acc[bi][1]))
         results.append((r0, r1))
         log(f"band {bi}: residual {r0:.4f} -> {r1:.4f}")
-    ds.write_tile(0, res_all, column="corrected")
 
     # write per-band solutions
     with open(cfg.out_solutions, "w") as fh:

@@ -25,6 +25,11 @@ HDF5 layout (all datasets chunked by timeslot for tile streaming):
   /flag              (ntime, nbase, nchan) bool
   /freqs             (nchan,) float64
   attrs: freq0, deltaf, deltat, ra0, dec0, nstations, time_jd0
+  optional /beam group (the LBeam metadata of data.h:76-106 — station
+  geometry + element offsets read from LOFAR_ANTENNA_FIELD):
+    longitude latitude (N,) rad; elem_x elem_y elem_z elem_mask
+    (N, Kmax) metres/bool; attrs b_ra0 b_dec0 (beam pointing) and
+    bf_type (STAT_* beamformer type)
 """
 
 from __future__ import annotations
@@ -160,6 +165,39 @@ class VisDataset:
             nstations=m.nstations,
         )
 
+    def load_beam(self):
+        """Beam metadata -> (StationGeometry, BeamPointing) or None when
+        the dataset carries no /beam group (the readAuxData beam path,
+        data.cpp LBeam; element offsets from LOFAR_ANTENNA_FIELD)."""
+        if "beam" not in self._f:
+            return None
+        from sagecal_tpu.ops.beam import BeamPointing, StationGeometry
+
+        g = self._f["beam"]
+        m = self.meta
+        geom = StationGeometry(
+            longitude=jnp.asarray(g["longitude"]),
+            latitude=jnp.asarray(g["latitude"]),
+            x=jnp.asarray(g["elem_x"]),
+            y=jnp.asarray(g["elem_y"]),
+            z=jnp.asarray(g["elem_z"]),
+            elem_mask=jnp.asarray(np.asarray(g["elem_mask"], np.float64)),
+            bf_type=int(g.attrs.get("bf_type", 1)),
+        )
+        pointing = BeamPointing(
+            ra0=m.ra0, dec0=m.dec0,
+            b_ra0=float(g.attrs.get("b_ra0", m.ra0)),
+            b_dec0=float(g.attrs.get("b_dec0", m.dec0)),
+            f0=float(g.attrs.get("beam_f0", m.freq0)),
+        )
+        return geom, pointing
+
+    def time_jd(self, t0: int, nt: int) -> np.ndarray:
+        """Julian dates of timeslots [t0, t0+nt) (beam evaluation epochs,
+        predict_withbeam.c time_utc)."""
+        m = self.meta
+        return m.time_jd0 + (t0 + np.arange(nt)) * m.deltat / 86400.0
+
     def write_tile(self, t0: int, vis: np.ndarray, column: str = "vis"):
         """Write (rows, nchan, 2, 2) visibilities back at timeslot t0
         (the writeData role; ``column`` creates e.g. 'corrected')."""
@@ -194,7 +232,11 @@ def create_dataset(
     ra0: float = 0.0,
     dec0: float = 0.0,
     time_jd0: float = 0.0,
+    beam: Optional[dict] = None,
 ) -> None:
+    """``beam``: optional dict with keys longitude, latitude (N,),
+    elem_x/elem_y/elem_z/elem_mask (N, Kmax) and optional b_ra0, b_dec0,
+    bf_type, beam_f0 — stored as the /beam group (LBeam metadata)."""
     with h5py.File(path, "w") as f:
         for name, arr in (("u", u), ("v", v), ("w", w)):
             f.create_dataset(name, data=np.asarray(arr, np.float64),
@@ -214,6 +256,14 @@ def create_dataset(
         f.attrs["ra0"] = ra0
         f.attrs["dec0"] = dec0
         f.attrs["time_jd0"] = time_jd0
+        if beam is not None:
+            g = f.create_group("beam")
+            for k in ("longitude", "latitude", "elem_x", "elem_y",
+                      "elem_z", "elem_mask"):
+                g.create_dataset(k, data=np.asarray(beam[k]))
+            for k in ("b_ra0", "b_dec0", "bf_type", "beam_f0"):
+                if k in beam:
+                    g.attrs[k] = beam[k]
 
 
 def simulate_dataset(
@@ -228,9 +278,15 @@ def simulate_dataset(
     noise_sigma: float = 0.0,
     seed: int = 0,
     dec0: float = 0.9,
+    with_beam: bool = False,
+    nelem: int = 24,
 ) -> None:
     """Build a synthetic vis.h5 (the hermetic stand-in for the
-    reference's packaged test MS, test/Calibration/README.md)."""
+    reference's packaged test MS, test/Calibration/README.md).
+
+    ``with_beam=True`` attaches a synthetic /beam group: per-station
+    random dipole layouts in a 30 m disk (the role of the
+    LOFAR_ANTENNA_FIELD element offsets)."""
     from sagecal_tpu.core.baselines import tile_baselines
     from sagecal_tpu.io.simulate import station_layout, uvw_track
     from sagecal_tpu.ops.rime import predict_model
@@ -258,6 +314,20 @@ def simulate_dataset(
         visr = visr + noise_sigma * (
             rng.standard_normal(visr.shape) + 1j * rng.standard_normal(visr.shape)
         )
+    beam = None
+    if with_beam:
+        brng = np.random.default_rng(seed + 1)
+        r = 30.0 * np.sqrt(brng.uniform(0.2, 1.0, (nstations, nelem)))
+        th = brng.uniform(0, 2 * np.pi, (nstations, nelem))
+        beam = dict(
+            longitude=np.full(nstations, 0.12),  # ~LOFAR core lon (rad)
+            latitude=np.full(nstations, 0.92),
+            elem_x=r * np.cos(th),
+            elem_y=r * np.sin(th),
+            elem_z=np.zeros((nstations, nelem)),
+            elem_mask=np.ones((nstations, nelem), bool),
+            b_ra0=0.0, b_dec0=dec0, bf_type=1, beam_f0=freq0,
+        )
     create_dataset(
         path,
         u=(us * C0).reshape(ntime, nbase),
@@ -270,6 +340,8 @@ def simulate_dataset(
         nstations=nstations,
         deltaf=chan_bw * nchan,
         dec0=dec0,
+        time_jd0=2460000.5,
+        beam=beam,
     )
 
 

@@ -258,6 +258,46 @@ def load_sky(
     return batches, cdefs
 
 
+def read_cluster_rho(
+    path: str, cdefs: list, spatialreg: bool = False
+):
+    """Per-cluster ADMM regularization file (the ``-G`` option;
+    ``read_arho_fromfile``, readsky.c:783-860, format decl
+    Dirac_radio.h:120-144): one line per cluster,
+
+        cluster_id  hybrid  admm_rho  [spatial_alpha]
+
+    Values are aligned to ``cdefs`` order by cluster_id when every id
+    matches, else taken in file order.  Returns (rho (M,), alpha (M,) or
+    None)."""
+    entries = []
+    with open(path) as fh:
+        for line in fh:
+            s = line.strip()
+            if not s or s.startswith("#") or s.startswith("//"):
+                continue
+            tok = s.split()
+            if len(tok) < 3:
+                continue
+            cid, hyb, rho = int(tok[0]), int(tok[1]), float(tok[2])
+            alpha = float(tok[3]) if (spatialreg and len(tok) > 3) else 0.0
+            entries.append((cid, hyb, rho, alpha))
+    M = len(cdefs)
+    if len(entries) < M:
+        raise ValueError(
+            f"{path}: {len(entries)} entries for {M} clusters"
+        )
+    by_id = {e[0]: e for e in entries}
+    ordered = (
+        [by_id[cd.cluster_id] for cd in cdefs]
+        if all(cd.cluster_id in by_id for cd in cdefs)
+        else entries[:M]
+    )
+    rho = np.asarray([e[2] for e in ordered])
+    alpha = np.asarray([e[3] for e in ordered]) if spatialreg else None
+    return rho, alpha
+
+
 def read_shapelet_modes(name: str, directory: str = ".") -> tuple[int, float, np.ndarray]:
     """Read ``<name>.fits.modes`` -> (n0, beta, modes[n0*n0])
     (format per readsky.c:143-200: first non-comment number pair is n0 and

@@ -140,3 +140,17 @@ def radec_to_lmn(ra, dec, ra0, dec0):
     m = sd * cd0 - cd * sd0 * np.cos(dra)
     n = sd * sd0 + cd * cd0 * np.cos(dra)
     return l, m, n - 1.0
+
+
+def lmn_to_radec(ll, mm, ra0, dec0):
+    """Inverse of :func:`radec_to_lmn`: sky coordinates of direction
+    cosines (l, m) about phase center (ra0, dec0).  Needed by the
+    beam-aware predict path, which evaluates az/el per source from
+    (ra, dec) while the source batches carry only lmn."""
+    ll = np.asarray(ll)
+    mm = np.asarray(mm)
+    n = np.sqrt(np.maximum(1.0 - ll * ll - mm * mm, 0.0))
+    sd0, cd0 = np.sin(dec0), np.cos(dec0)
+    dec = np.arcsin(np.clip(mm * cd0 + n * sd0, -1.0, 1.0))
+    ra = ra0 + np.arctan2(ll, n * cd0 - mm * sd0)
+    return ra, dec

@@ -4,11 +4,18 @@ Redesign of ``sagefit_visibilities_admm`` (``/root/reference/src/lib/
 Dirac/admm_solve.c:221``): an EM pass over clusters where each
 per-cluster solve minimizes the data misfit PLUS the scaled-Lagrangian
 consensus terms ``y^T (J - BZ) + rho/2 ||J - BZ||^2`` (cost contract
-Dirac.h:1182-1195).  The reference dispatches to RTR/NSD/LM ADMM
-variants per solver mode; here the augmented terms enter the batched
-LM's normal equations exactly (they are quadratic), so one lock-step
-solver covers all chunks, and the EM structure is the shared
-:func:`sagecal_tpu.solvers.sage.em_residual_scan`.
+Dirac.h:1182-1195).  Like the reference, the local solver is dispatched
+on solver mode: the CPU reference always runs robust RTR-ADMM
+(admm_solve.c:346 ``rtr_solve_nocuda_robust_admm``) and the GPU
+pipeline picks NSD-ADMM for ``SM_NSD_RLBFGS`` (admm_solve.c:463-467);
+here LM/RTR/NSD all carry the augmented terms, so any mode works:
+
+- ``SM_LM_LBFGS`` / ``SM_OSLM_LBFGS``: batched LM with the quadratic
+  terms folded into the normal equations (lm.py).
+- ``SM_RTR_OSLM_LBFGS``: plain RTR-ADMM.
+- ``SM_RTR_OSRLM_RLBFGS`` (+ any robust mode except NSD): Student's-t
+  robust RTR-ADMM — the reference MPI slave's default local solver.
+- ``SM_NSD_RLBFGS``: robust NSD-ADMM.
 """
 
 from __future__ import annotations
@@ -22,6 +29,11 @@ from sagecal_tpu.core.types import VisData
 from sagecal_tpu.solvers.lm import LMConfig, _residual_rows, lm_solve
 from sagecal_tpu.solvers.robust import update_w_and_nu
 from sagecal_tpu.solvers.sage import (
+    SM_LM_LBFGS,
+    SM_NSD_RLBFGS,
+    SM_RTR_OSLM_LBFGS,
+    SM_RTR_OSRLM_RLBFGS,
+    _ROBUST_MODES,
     ClusterData,
     _res_norm,
     em_residual_scan,
@@ -45,6 +57,9 @@ def admm_sagefit(
     max_emiter: int = 1,
     lm_config: LMConfig = LMConfig(),
     robust_nu: Optional[float] = None,
+    solver_mode: int = SM_LM_LBFGS,
+    nulow: float = 2.0,
+    nuhigh: float = 30.0,
 ) -> AdmmLocalResult:
     """One worker's ADMM x-update for one tile.
 
@@ -55,9 +70,11 @@ def admm_sagefit(
         rtr_solve_robust_admm).
       rho: (M,) per-cluster penalties (already fratio-scaled by the
         caller, sagecal_master.cpp:709-723).
-      robust_nu: optional Student's-t nu — when given, each cluster solve
-        is IRLS-weighted by w = (nu+1)/(nu+e^2) from the residual at the
-        incoming solution (the robust ADMM path's E-step).
+      robust_nu: optional Student's-t nu — when given with an LM mode,
+        each cluster solve is IRLS-weighted by w = (nu+1)/(nu+e^2) from
+        the residual at the incoming solution (the robust ADMM path's
+        E-step); robust RTR/NSD modes run their own nu EM instead.
+      solver_mode: SM_* dispatch (see module docstring).
     """
     rows, F = data.vis.shape[0], data.vis.shape[1]
     nreal = rows * F * 8
@@ -65,10 +82,53 @@ def admm_sagefit(
     full0 = predict_full_model(p0, cdata, data)
     res_0 = _res_norm(data.vis - full0, data.mask, nreal)
 
-    mask8 = jnp.repeat(data.mask, 8, axis=-1) if robust_nu is not None else None
+    use_rtr = solver_mode in (SM_RTR_OSLM_LBFGS, SM_RTR_OSRLM_RLBFGS)
+    use_nsd = solver_mode == SM_NSD_RLBFGS
+    robust = solver_mode in _ROBUST_MODES
+    mask8 = (
+        jnp.repeat(data.mask, 8, axis=-1)
+        if (robust_nu is not None and not (use_rtr or use_nsd))
+        else None
+    )
+    nu0 = jnp.asarray(
+        robust_nu if robust_nu is not None else nulow, p0.dtype
+    )
 
     def solve_one(xeff, coh_k, cmap_k, p_k, extras_k):
         y_k, bz_k, rho_k = extras_k
+        if use_rtr or use_nsd:
+            from sagecal_tpu.solvers.rtr import (
+                RTRConfig,
+                nsd_solve,
+                nsd_solve_robust,
+                rtr_solve,
+                rtr_solve_robust,
+            )
+
+            itmax = lm_config.itmax
+            if use_nsd:
+                res, _ = nsd_solve_robust(
+                    xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k,
+                    p_k, itmax=itmax + 15, nu0=nu0, nulow=nulow,
+                    nuhigh=nuhigh,
+                    admm_y=y_k, admm_bz=bz_k, admm_rho=rho_k,
+                )
+            elif robust:
+                res, _ = rtr_solve_robust(
+                    xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k,
+                    p_k,
+                    RTRConfig(itmax_rsd=itmax + 5, itmax_rtr=itmax + 10),
+                    nu0=nu0, nulow=nulow, nuhigh=nuhigh,
+                    admm_y=y_k, admm_bz=bz_k, admm_rho=rho_k,
+                )
+            else:
+                res = rtr_solve(
+                    xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k,
+                    p_k,
+                    RTRConfig(itmax_rsd=itmax + 5, itmax_rtr=itmax + 10),
+                    admm_y=y_k, admm_bz=bz_k, admm_rho=rho_k,
+                )
+            return res.p, None
         if robust_nu is not None:
             ed = _residual_rows(
                 p_k, coh_k, xeff, data.mask, data.ant_p, data.ant_q, cmap_k, None

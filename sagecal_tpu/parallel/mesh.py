@@ -5,10 +5,11 @@ This replaces the reference's MPI master/worker star
 ``sagecal_slave.cpp``, p2p tags ``proto.h:24-59``) with a single SPMD
 program over a ``jax.sharding.Mesh``:
 
-- each device along the ``freq`` axis owns one sub-band's visibility
-  tile — the reference's "one MPI worker per group of MS";
+- each device along the ``freq`` axis owns one OR MORE sub-bands'
+  visibility tiles — the reference's "one MPI worker per group of MS";
 - the ADMM x-step (:func:`sagecal_tpu.parallel.admm.admm_sagefit`) runs
-  independently per shard;
+  independently per shard, dispatched on solver mode (LM / robust RTR /
+  NSD with the ADMM-augmented cost) like ``admm_solve.c:221``;
 - the master's Z-update ``z = sum_f B_f (x) (Y_f + rho_f J_f)`` is a
   ``lax.psum`` over the freq axis (sagecal_master.cpp:841-852 was a
   recv+accumulate loop), and ``Bii = pinv(sum_f rho_f B_f B_f^T)`` is a
@@ -16,15 +17,26 @@ program over a ``jax.sharding.Mesh``:
 - the manifold-averaging alignment at the first iteration becomes an
   ``all_gather`` of (M, N, 2, 2) Jones blocks (small) + replicated math.
 
+Data multiplexing (more sub-bands than devices): with Nf = G * ndev the
+leading sub-band axis shards into contiguous groups of G per device
+(the reference assigns contiguous MS lists per worker,
+sagecal_master.cpp:60-224).  ADMM iteration ``it`` solves local group
+slot ``it % G`` — the ``Sbegin/Scurrent/Send`` rotation of
+sagecal_master.cpp:157-206 / README.md:139-141 — while the z-step psums
+the STORED ``Yhat = Y + rho J`` of every sub-band (stale for inactive
+slots, exactly the reference's multiplexed semantics where only the
+active MS's Y refreshes per iteration).
+
 Iteration protocol (matches slave/master handshake order,
 sagecal_slave.cpp:727-895):
-  admm 0:  plain (unaugmented) solve; align J across frequencies on the
-           quotient manifold; Yhat = rho*J; z-step; Y = Yhat - rho*BZ.
-  admm>0:  augmented solve with (Y, BZ); Yhat = Y + rho*J; z-step with
-           the NEW J; dual update against the NEW consensus,
-           Y = Yhat - rho*BZ_new; optional Barzilai-Borwein rho update
-           every other iteration (consensus_poly.c:860-911, cadence at
-           sagecal_slave.cpp:899).
+  admm 0:  plain (unaugmented) solve of ALL local slots; align J across
+           sub-bands on the quotient manifold; Yhat = rho*J; z-step;
+           Y = Yhat - rho*BZ.
+  admm>0:  augmented solve of the active slot with (Y, BZ);
+           Yhat = Y + rho*J; z-step with the NEW J; dual update against
+           the NEW consensus, Y = Yhat - rho*BZ_new; optional
+           Barzilai-Borwein rho update every other iteration
+           (consensus_poly.c:860-911, cadence at sagecal_slave.cpp:899).
 
 Multi-host scaling: build the Mesh over ``jax.devices()`` spanning
 hosts (``jax.distributed.initialize``); the same psum/all_gather ride
@@ -34,7 +46,7 @@ section 5's mapping.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -46,7 +58,7 @@ from sagecal_tpu.parallel import consensus
 from sagecal_tpu.parallel.admm import admm_sagefit
 from sagecal_tpu.parallel.manifold import manifold_average
 from sagecal_tpu.solvers.lm import LMConfig
-from sagecal_tpu.solvers.sage import ClusterData
+from sagecal_tpu.solvers.sage import SM_LM_LBFGS, ClusterData
 
 
 class AdmmResult(NamedTuple):
@@ -66,14 +78,23 @@ def _unflat(x, nchunk, n8):
     return x.reshape(x.shape[:-1] + (nchunk, n8))
 
 
-def _zstep(Yhat_flat, rho, B_f, axis_name, federated_alpha=None):
-    """psum z accumulation + replicated Bii + Z update.  Yhat_flat (M, K)."""
-    z = jax.lax.psum(consensus.accumulate_z_term(B_f, Yhat_flat), axis_name)
-    P_term = jnp.einsum("m,p,q->mpq", rho, B_f, B_f)
+def _zstep_grouped(Yhat_flat, rho, B_g, axis_name, federated_alpha=None):
+    """psum z accumulation + replicated Bii + Z update.
+
+    Yhat_flat (G, M, K); rho (G, M); B_g (G, Npoly) — all local
+    sub-bands contribute (vmapped accumulate, summed locally, then
+    psum'd across the mesh)."""
+    z_local = jnp.sum(
+        jax.vmap(consensus.accumulate_z_term)(B_g, Yhat_flat), axis=0
+    )
+    z = jax.lax.psum(z_local, axis_name)
+    P_term = jnp.einsum("gm,gp,gq->mpq", rho, B_g, B_g)
     P_sum = jax.lax.psum(P_term, axis_name)
     if federated_alpha is not None:
-        Np = B_f.shape[0]
-        P_sum = P_sum + federated_alpha[:, None, None] * jnp.eye(Np, dtype=P_sum.dtype)[None]
+        Np = B_g.shape[-1]
+        P_sum = P_sum + federated_alpha[:, None, None] * jnp.eye(
+            Np, dtype=P_sum.dtype
+        )[None]
     Bii = jnp.linalg.pinv(P_sum)
     return consensus.update_global_z(z, Bii)
 
@@ -88,81 +109,125 @@ def make_admm_mesh_fn(
     use_manifold_align: bool = True,
     bb_rho: bool = False,
     rho_upper: float = 1e3,
+    solver_mode: int = SM_LM_LBFGS,
+    robust_nu: Optional[float] = None,
 ):
     """Build the jitted mesh-wide ADMM calibration function.
 
     The returned fn takes leading-axis-``Nf`` stacks (sharded over the
-    ``freq`` mesh axis):
+    ``freq`` mesh axis; Nf must be a multiple of the mesh size — pad
+    with zero-weight bands otherwise):
       fn(data_stack: VisData pytree with (Nf, ...) leaves,
          cdata_stack: ClusterData pytree (Nf, ...),
          p0: (Nf, M, nchunk_max, 8N), rho: (Nf, M), B: (Nf, Npoly))
     and returns an :class:`AdmmResult`.  The whole Nadmm loop runs in one
     jit/shard_map program.
+
+    ``solver_mode``/``robust_nu`` select the local x-step solver the way
+    ``sagefit_visibilities_admm`` dispatches (see
+    :func:`sagecal_tpu.parallel.admm.admm_sagefit`).
     """
 
-    def local_loop(data: VisData, cdata: ClusterData, p0, rho, B_f):
-        M, nchunk_max, n8 = p0.shape
-        zeros = jnp.zeros_like(p0)
-
-        # ---- admm 0: plain solve (sagecal_slave.cpp:727 sagefit) -------
-        r0 = admm_sagefit(
-            data, cdata, p0, zeros, zeros, jnp.zeros_like(rho),
-            max_emiter=plain_emiter, lm_config=lm_config,
+    def _fit(data, cdata, p, Y, BZ, rho_m, emiter):
+        return admm_sagefit(
+            data, cdata, p, Y, BZ, rho_m,
+            max_emiter=emiter, lm_config=lm_config,
+            solver_mode=solver_mode, robust_nu=robust_nu,
         )
-        p = r0.p
+
+    def local_loop(data: VisData, cdata: ClusterData, p0, rho, B_g):
+        # all array leaves carry the local sub-band group axis G
+        G, M, nchunk_max, n8 = p0.shape
+        zeros_g = jnp.zeros_like(p0[0])
+
+        # ---- admm 0: plain solve of every local slot -------------------
+        def plain_one(_, inp):
+            d_g, c_g, p_g, rho_g = inp
+            r = _fit(d_g, c_g, p_g, zeros_g, zeros_g,
+                     jnp.zeros_like(rho_g), plain_emiter)
+            return None, r.p
+
+        _, p = jax.lax.scan(plain_one, None, (data, cdata, p0, rho))
+
         if use_manifold_align:
-            # master-side unitary-ambiguity fix (sagecal_master.cpp:826-838)
-            jones = params_to_jones(p)  # (M, nchunk, N, 2, 2)
-            gath = jax.lax.all_gather(jones, axis_name)  # (Nf, M, nchunk, N, 2, 2)
-            Nf = gath.shape[0]
-            gflat = gath.reshape(Nf, M, -1, 2, 2)
+            # master-side unitary-ambiguity fix over ALL Nf sub-bands
+            # (sagecal_master.cpp:826-838)
+            jones = params_to_jones(p)  # (G, M, nchunk, N, 2, 2)
+            gath = jax.lax.all_gather(jones, axis_name)  # (ndev, G, ...)
+            ndev, G_, Mm = gath.shape[0], gath.shape[1], gath.shape[2]
+            gflat = gath.reshape(ndev * G_, Mm, -1, 2, 2)
             aligned = manifold_average(gflat, niter=20)
             idx = jax.lax.axis_index(axis_name)
-            p = jones_to_params(aligned[idx].reshape(jones.shape)).astype(p0.dtype)
+            own = aligned.reshape((ndev, G_) + aligned.shape[1:])[idx]
+            p = jones_to_params(own.reshape(jones.shape)).astype(p0.dtype)
 
-        Yhat = rho[:, None, None] * p  # Y=0 so Yhat = rho*J
-        Z = _zstep(_flat(Yhat), rho, B_f, axis_name)
-        BZ = _unflat(consensus.bz_for_freq(Z, B_f), nchunk_max, n8)
-        Y = Yhat - rho[:, None, None] * BZ
+        Yhat = rho[:, :, None, None] * p  # Y=0 so Yhat = rho*J
+        Z = _zstep_grouped(_flat(Yhat), rho, B_g, axis_name)
 
-        # ---- admm > 0 ---------------------------------------------------
-        def one_iter(carry, it):
-            p, Y, Z, rho, Yhat_prev, p_prev = carry
-            BZ = _unflat(consensus.bz_for_freq(Z, B_f), nchunk_max, n8)
-            loc = admm_sagefit(
-                data, cdata, p, Y, BZ, rho,
-                max_emiter=max_emiter, lm_config=lm_config,
+        def bz_of(Z_, g):
+            return _unflat(
+                consensus.bz_for_freq(Z_, B_g[g]), nchunk_max, n8
             )
-            p1 = loc.p
-            Yhat = Y + rho[:, None, None] * p1
-            Z1 = _zstep(_flat(Yhat), rho, B_f, axis_name)
-            BZ1 = _unflat(consensus.bz_for_freq(Z1, B_f), nchunk_max, n8)
-            Y1 = Yhat - rho[:, None, None] * BZ1
+
+        BZ_all = jax.vmap(lambda g: bz_of(Z, g))(jnp.arange(G))
+        Y = Yhat - rho[:, :, None, None] * BZ_all
+
+        # ---- admm > 0: rotate over local slots -------------------------
+        def one_iter(carry, it):
+            p, Y, Z, rho, Yhat_all, Yhat_prev, p_prev = carry
+            g = (it - 1) % G  # active local slot (Scurrent rotation)
+            d_g = jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_index_in_dim(x, g, keepdims=False),
+                data,
+            )
+            c_g = jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_index_in_dim(x, g, keepdims=False),
+                cdata,
+            )
+            p_g = p[g]
+            Y_g = Y[g]
+            rho_g = rho[g]
+            BZ_g = bz_of(Z, g)
+            loc = _fit(d_g, c_g, p_g, Y_g, BZ_g, rho_g, max_emiter)
+            p1_g = loc.p
+            Yhat_g = Y_g + rho_g[:, None, None] * p1_g
+            p1 = p.at[g].set(p1_g)
+            Yhat_all1 = Yhat_all.at[g].set(Yhat_g)
+            Z1 = _zstep_grouped(_flat(Yhat_all1), rho, B_g, axis_name)
+            BZ1_g = bz_of(Z1, g)
+            Y1 = Y.at[g].set(Yhat_g - rho_g[:, None, None] * BZ1_g)
             dres = consensus.admm_dual_residual(Z1, Z)
-            pr = _flat(p1 - BZ1)
+            pr = _flat(p1_g - BZ1_g)
             pres = jax.lax.pmean(
                 jnp.linalg.norm(pr.ravel()) / jnp.sqrt(pr.size), axis_name
             )
             if bb_rho:
-                dY = _flat(Yhat) - _flat(Yhat_prev)
-                dJ = _flat(p1) - _flat(p_prev)
-                rho_new = consensus.update_rho_bb(
-                    rho, jnp.full_like(rho, rho_upper), dY, dJ
+                dY = _flat(Yhat_g) - _flat(Yhat_prev[g])
+                dJ = _flat(p1_g) - _flat(p_prev[g])
+                rho_new_g = consensus.update_rho_bb(
+                    rho_g, jnp.full_like(rho_g, rho_upper), dY, dJ
                 )
-                # BB cadence: update every other iteration
+                # BB cadence: update every other visit to this slot
                 # (sagecal_slave.cpp:899)
-                rho1 = jnp.where(it % 2 == 0, rho_new, rho)
+                visit = (it - 1) // G
+                rho1 = rho.at[g].set(
+                    jnp.where(visit % 2 == 1, rho_new_g, rho_g)
+                )
             else:
                 rho1 = rho
-            return (p1, Y1, Z1, rho1, Yhat, p1), (dres, pres)
+            Yhat_prev1 = Yhat_prev.at[g].set(Yhat_g)
+            p_prev1 = p_prev.at[g].set(p1_g)
+            return (p1, Y1, Z1, rho1, Yhat_all1, Yhat_prev1, p_prev1), (
+                dres, pres,
+            )
 
-        init = (p, Y, Z, rho, Yhat, p)
-        (p, Y, Z, rho, _, _), (dres, pres) = jax.lax.scan(
+        init = (p, Y, Z, rho, Yhat, Yhat, p)
+        (p, Y, Z, rho, _, _, _), (dres, pres) = jax.lax.scan(
             one_iter, init, jnp.arange(1, nadmm)
         )
         dres = jnp.concatenate([jnp.zeros((1,), dres.dtype), dres])
         pres = jnp.concatenate([jnp.zeros((1,), pres.dtype), pres])
-        return p[None], Y[None], Z, rho[None], dres, pres
+        return p, Y, Z, rho, dres, pres
 
     fspec = P(axis_name)
     rspec = P()
@@ -171,18 +236,14 @@ def make_admm_mesh_fn(
 
     @jax.jit
     def fn(data_stack, cdata_stack, p0, rho, B):
-        if p0.shape[0] != ndev:
+        Nf = p0.shape[0]
+        if Nf % ndev != 0:
             raise ValueError(
-                f"leading (sub-band) axis {p0.shape[0]} != mesh size {ndev}; "
-                "data multiplexing (more sub-bands than devices) is not yet "
-                "supported — group sub-bands per device first"
+                f"sub-band count {Nf} must be a multiple of the mesh size "
+                f"{ndev}; pad with zero-weight bands (rho=0, mask=0) first"
             )
         sm = jax.shard_map(
-            lambda d, c, p, r, b: local_loop(
-                jax.tree_util.tree_map(lambda x: x[0], d),
-                jax.tree_util.tree_map(lambda x: x[0], c),
-                p[0], r[0], b[0],
-            ),
+            local_loop,
             mesh=mesh,
             in_specs=(fspec, fspec, fspec, fspec, fspec),
             out_specs=(fspec, fspec, rspec, fspec, rspec, rspec),

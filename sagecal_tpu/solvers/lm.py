@@ -236,8 +236,12 @@ def lm_solve(
         dp = _solve_spd(A, JTe)
         pnew = p + dp
         cost_new = aug_cost(pnew, _cost_only(pnew, *args))
-        # gain ratio (cost - cost_new) / (dp.(mu*dp + JTe))
-        denom = jnp.sum(dp * (mu[:, None] * dp + JTe), axis=-1)
+        # gain ratio (cost - cost_new) / (dp.(damp*dp + JTe)): the
+        # predicted decrease of the (possibly ADMM-augmented) quadratic
+        # model must use the same damping the step was solved with —
+        # damp = mu + rho/2 in consensus solves — or the ratio
+        # misestimates and mu adaptation drifts for large rho
+        denom = jnp.sum(dp * (damp[:, None] * dp + JTe), axis=-1)
         gain = (cost - cost_new) / jnp.where(denom == 0.0, 1e-30, denom)
         accept = (gain > 0.0) & jnp.isfinite(cost_new) & (~done)
         fac = jnp.maximum(1.0 / 3.0, 1.0 - (2.0 * gain - 1.0) ** 3)

@@ -100,26 +100,51 @@ def _model_rows(x, coh, ant_p, ant_q):
     return jp[:, None] @ coh @ jnp.conj(jnp.swapaxes(jq, -1, -2))[:, None]
 
 
-def _make_fns(vis, coh, rowmask, ant_p, ant_q, sqrt_w):
+def _make_fns(vis, coh, rowmask, ant_p, ant_q, sqrt_w, admm=None):
     """Build (cost, grad, hess) closures for one chunk lane.
 
     vis/coh: (rows, F, 2, 2) complex; rowmask: (rows, F) —
     already restricted to this chunk's rows; sqrt_w: optional robust
     sqrt-weights with vis's shape (broadcastable).
+
+    ``admm``: optional (Yc, BZc, rho) consensus terms ((N,2,2) complex
+    Lagrange multipliers / target, scalar penalty): the augmented cost
+    ``Re tr(Y^H (X-BZ)) + rho/2 ||X-BZ||^2`` of the ADMM solvers
+    (rtr_solve_robust_admm.c:199-215).  Following the reference, the
+    ADMM gradient terms ``0.5 Y + 0.5 rho (X-BZ)`` and Hessian term
+    ``0.5 rho eta`` are added AFTER the per-station iw normalization of
+    the data gradient (rtr_solve_robust_admm.c:680-689,941-942) and
+    before projection.
     """
 
+    def admm_cost(x):
+        if admm is None:
+            return jnp.asarray(0.0, vis.real.dtype)
+        Yc, BZc, rho = admm
+        d = x - BZc
+        return jnp.sum(jnp.real(jnp.conj(Yc) * d)) + 0.5 * rho * jnp.sum(
+            jnp.real(d) ** 2 + jnp.imag(d) ** 2
+        )
+
     def cost_c(x):
+        res = (vis - _model_rows(x, coh, ant_p, ant_q)) * rowmask[..., None, None]
+        if sqrt_w is not None:
+            res = res * sqrt_w
+        return jnp.sum(jnp.real(res) ** 2 + jnp.imag(res) ** 2) + admm_cost(x)
+
+    def data_cost_c(x):
         res = (vis - _model_rows(x, coh, ant_p, ant_q)) * rowmask[..., None, None]
         if sqrt_w is not None:
             res = res * sqrt_w
         return jnp.sum(jnp.real(res) ** 2 + jnp.imag(res) ** 2)
 
     def cost_ri(xri):
-        return cost_c(jax.lax.complex(xri[..., 0], xri[..., 1]))
+        return data_cost_c(jax.lax.complex(xri[..., 0], xri[..., 1]))
 
     def egrad(x):
-        """Euclidean gradient in the fns convention: 0.5*(df/dre + i df/dim)
-        so that df along eta = g(egrad, eta)."""
+        """DATA Euclidean gradient in the fns convention:
+        0.5*(df/dre + i df/dim) so that df along eta = g(egrad, eta).
+        ADMM terms are added separately (un-iw-weighted)."""
         xri = jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
         gri = jax.grad(cost_ri)(xri)
         return 0.5 * jax.lax.complex(gri[..., 0], gri[..., 1])
@@ -127,6 +152,9 @@ def _make_fns(vis, coh, rowmask, ant_p, ant_q, sqrt_w):
     def grad_fn(x, iw):
         """Weighted, projected Riemannian gradient (fns_fgrad)."""
         g = egrad(x) * iw[:, None, None]
+        if admm is not None:
+            Yc, BZc, rho = admm
+            g = g + 0.5 * (Yc + rho * (x - BZc))
         return _project(x, g)
 
     def hess_fn(x, eta, iw):
@@ -144,7 +172,10 @@ def _make_fns(vis, coh, rowmask, ant_p, ant_q, sqrt_w):
         xri = jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
         tri = jnp.stack([jnp.real(eta), jnp.imag(eta)], axis=-1)
         _, dri = jax.jvp(weg_ri, (xri,), (tri,))
-        return _project(x, jax.lax.complex(dri[..., 0], dri[..., 1]))
+        h = jax.lax.complex(dri[..., 0], dri[..., 1])
+        if admm is not None:
+            h = h + 0.5 * admm[2] * eta
+        return _project(x, h)
 
     return cost_c, grad_fn, hess_fn
 
@@ -232,13 +263,18 @@ def _tcg(x, grad, Delta, hess, cfg: RTRConfig):
 # ---------------------------------------------------------------------------
 
 def _rtr_single(
-    vis, coh, rowmask, ant_p, ant_q, x0, cfg: RTRConfig, sqrt_w, itmax_dyn=None
+    vis, coh, rowmask, ant_p, ant_q, x0, cfg: RTRConfig, sqrt_w, itmax_dyn=None,
+    admm=None,
 ):
     """``itmax_dyn``: optional traced base iteration budget; the RSD/TR
     bounds become min(static, dyn+5)/min(static, dyn+10), matching the
-    reference's this_itermax+5/+10 call-site offsets (lmfit.c:936)."""
+    reference's this_itermax+5/+10 call-site offsets (lmfit.c:936).
+    ``admm``: optional (Yc, BZc, rho) consensus augmentation
+    (rtr_solve_nocuda_robust_admm, rtr_solve_robust_admm.c)."""
     N = x0.shape[0]
-    cost_c, grad_fn, hess_fn = _make_fns(vis, coh, rowmask, ant_p, ant_q, sqrt_w)
+    cost_c, grad_fn, hess_fn = _make_fns(
+        vis, coh, rowmask, ant_p, ant_q, sqrt_w, admm
+    )
     iw = _station_iw(rowmask, ant_p, ant_q, N)
     rsd_bound = (
         jnp.asarray(cfg.itmax_rsd)
@@ -326,13 +362,19 @@ def _rtr_single(
     return xf, fx0, jnp.where(better, out["fx"], fx0)
 
 
-def _nsd_single(vis, coh, rowmask, ant_p, ant_q, x0, itmax, sqrt_w, itmax_dyn=None):
+def _nsd_single(
+    vis, coh, rowmask, ant_p, ant_q, x0, itmax, sqrt_w, itmax_dyn=None, admm=None
+):
     """Nesterov accelerated manifold descent
     (nsd_solve_nocuda_robust, rtr_solve_robust.c:1878-2090).
     ``itmax_dyn``: traced bound, effective limit min(itmax, dyn+15)
-    (the reference's this_itermax+15 call-site offset, lmfit.c:953)."""
+    (the reference's this_itermax+15 call-site offset, lmfit.c:953).
+    ``admm``: optional (Yc, BZc, rho) consensus augmentation
+    (nsd_solve_cuda_robust_admm_fl's CPU analog)."""
     N = x0.shape[0]
-    cost_c, grad_fn, hess_fn = _make_fns(vis, coh, rowmask, ant_p, ant_q, sqrt_w)
+    cost_c, grad_fn, hess_fn = _make_fns(
+        vis, coh, rowmask, ant_p, ant_q, sqrt_w, admm
+    )
     iw = _station_iw(rowmask, ant_p, ant_q, N)
     bound = (
         jnp.asarray(itmax)
@@ -389,15 +431,39 @@ def _nsd_single(vis, coh, rowmask, ant_p, ant_q, x0, itmax, sqrt_w, itmax_dyn=No
 # ---------------------------------------------------------------------------
 
 def _chunked(solver):
-    def run(vis, coh, mask, ant_p, ant_q, chunk_map, p0, *args, **kwargs):
+    def run(
+        vis, coh, mask, ant_p, ant_q, chunk_map, p0, *args,
+        admm_y=None, admm_bz=None, admm_rho=None, **kwargs,
+    ):
         nchunk = p0.shape[0]
         x0 = params_to_jones(p0)  # (nchunk, N, 2, 2)
 
-        def lane(c, x0_c):
-            rowmask = mask * (chunk_map == c)[:, None].astype(mask.dtype)
-            return solver(vis, coh, rowmask, ant_p, ant_q, x0_c, *args, **kwargs)
+        if admm_y is not None:
+            # param-space duals/targets -> complex Jones stacks; the real
+            # dot y.(p-bz) equals Re tr(Y^H (X-BZ)) elementwise
+            Yc = params_to_jones(admm_y)  # (nchunk, N, 2, 2)
+            BZc = params_to_jones(admm_bz)
+            rho = jnp.broadcast_to(
+                jnp.asarray(admm_rho, p0.dtype), (nchunk,)
+            )
 
-        xf, c0, c1 = jax.vmap(lane)(jnp.arange(nchunk), x0)
+            def lane(c, x0_c, y_c, bz_c, r_c):
+                rowmask = mask * (chunk_map == c)[:, None].astype(mask.dtype)
+                return solver(
+                    vis, coh, rowmask, ant_p, ant_q, x0_c, *args,
+                    admm=(y_c, bz_c, r_c), **kwargs,
+                )
+
+            xf, c0, c1 = jax.vmap(lane)(jnp.arange(nchunk), x0, Yc, BZc, rho)
+        else:
+
+            def lane(c, x0_c):
+                rowmask = mask * (chunk_map == c)[:, None].astype(mask.dtype)
+                return solver(
+                    vis, coh, rowmask, ant_p, ant_q, x0_c, *args, **kwargs
+                )
+
+            xf, c0, c1 = jax.vmap(lane)(jnp.arange(nchunk), x0)
         return RTRResult(p=jones_to_params(xf), cost0=c0, cost=c1)
 
     return run
@@ -408,17 +474,21 @@ def rtr_solve(
     config: RTRConfig = RTRConfig(),
     sqrt_weights: Optional[jax.Array] = None,
     itmax_dynamic=None,
+    admm_y=None, admm_bz=None, admm_rho=None,
 ) -> RTRResult:
     """Batched-over-chunks RTR solve (``rtr_solve_nocuda``, Dirac.h:1132).
 
     Args mirror :func:`sagecal_tpu.solvers.lm.lm_solve`; ``sqrt_weights``
     optional (rows, F, 2, 2)-broadcastable robust sqrt-weights;
     ``itmax_dynamic`` optional traced per-call iteration budget (the
-    SAGE driver's weighted allocation).
+    SAGE driver's weighted allocation).  ``admm_y/admm_bz`` (nchunk, 8N)
+    + scalar ``admm_rho`` switch on the consensus-augmented cost
+    (``rtr_solve_nocuda_admm``/``..._robust_admm``, decl
+    Dirac.h:1182-1195).
     """
     return _chunked(_rtr_single)(
         vis, coh, mask, ant_p, ant_q, chunk_map, p0, config, sqrt_weights,
-        itmax_dynamic,
+        itmax_dynamic, admm_y=admm_y, admm_bz=admm_bz, admm_rho=admm_rho,
     )
 
 
@@ -427,12 +497,14 @@ def nsd_solve(
     itmax: int = 10,
     sqrt_weights: Optional[jax.Array] = None,
     itmax_dynamic=None,
+    admm_y=None, admm_bz=None, admm_rho=None,
 ) -> RTRResult:
     """Batched Nesterov steepest descent (``nsd_solve_nocuda_robust``,
-    Dirac.h:1166)."""
+    Dirac.h:1166); ADMM-augmented when ``admm_y/admm_bz/admm_rho`` given
+    (``nsd_solve_nocuda_robust_admm``, decl Dirac.h:1207-1224)."""
     return _chunked(_nsd_single)(
         vis, coh, mask, ant_p, ant_q, chunk_map, p0, itmax, sqrt_weights,
-        itmax_dynamic,
+        itmax_dynamic, admm_y=admm_y, admm_bz=admm_bz, admm_rho=admm_rho,
     )
 
 
@@ -469,12 +541,16 @@ def rtr_solve_robust(
     nu0=2.0, nulow: float = 2.0, nuhigh: float = 30.0,
     em_iters: int = 2,
     itmax_dynamic=None,
+    admm_y=None, admm_bz=None, admm_rho=None,
 ):
     """Student's-t EM wrapping RTR (``rtr_solve_nocuda_robust``,
     Dirac.h:1145): E-step per-baseline weights (see
     :func:`_robust_weights_and_nu`), M-step a weighted RTR solve.
     ``nu0`` may be a traced value (the SAGE driver carries nu across EM
-    passes, lmfit.c:940-947).  Returns (RTRResult, nu)."""
+    passes, lmfit.c:940-947).  With ``admm_*`` given this is
+    ``rtr_solve_nocuda_robust_admm`` (rtr_solve_robust_admm.c:1427),
+    the reference MPI slave's default local solver.
+    Returns (RTRResult, nu)."""
 
     def em(carry, _):
         p, nu = carry
@@ -484,6 +560,7 @@ def rtr_solve_robust(
         out = rtr_solve(
             vis, coh, mask, ant_p, ant_q, chunk_map, p, config,
             sqrt_weights=sqrt_w, itmax_dynamic=itmax_dynamic,
+            admm_y=admm_y, admm_bz=admm_bz, admm_rho=admm_rho,
         )
         return (out.p, nu1), (out.cost0, out.cost)
 
@@ -504,11 +581,14 @@ def nsd_solve_robust(
     nu0=2.0, nulow: float = 2.0, nuhigh: float = 30.0,
     em_iters: int = 2,
     itmax_dynamic=None,
+    admm_y=None, admm_bz=None, admm_rho=None,
 ):
     """Robust Nesterov descent (``nsd_solve_nocuda_robust``,
     rtr_solve_robust.c:1878): the same Student's-t EM around
     :func:`nsd_solve`, with nu re-estimated from the residual after each
-    solve (rtr_solve_robust.c:2104-2105).  Returns (RTRResult, nu)."""
+    solve (rtr_solve_robust.c:2104-2105).  With ``admm_*`` given this is
+    the NSD-ADMM local solver (``nsd_solve_nocuda_robust_admm``, decl
+    Dirac.h:1207).  Returns (RTRResult, nu)."""
 
     def em(carry, _):
         p, nu = carry
@@ -518,6 +598,7 @@ def nsd_solve_robust(
         out = nsd_solve(
             vis, coh, mask, ant_p, ant_q, chunk_map, p, itmax,
             sqrt_weights=sqrt_w, itmax_dynamic=itmax_dynamic,
+            admm_y=admm_y, admm_bz=admm_bz, admm_rho=admm_rho,
         )
         return (out.p, nu1), (out.cost0, out.cost)
 

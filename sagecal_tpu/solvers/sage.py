@@ -38,6 +38,7 @@ from typing import NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from sagecal_tpu.core.types import VisData, params_to_jones
@@ -69,6 +70,15 @@ class SageConfig:
     nuhigh: float = struct.field(pytree_node=False, default=30.0)
     randomize: bool = struct.field(pytree_node=False, default=True)
     em_rounds_robust: int = struct.field(pytree_node=False, default=2)
+    # Static ceiling multiplier for the weighted per-cluster iteration
+    # allocation (lmfit.c:859-882): a high-error cluster may be granted up
+    # to iter_budget_cap * max_iter iterations by the -R weighting.  The
+    # reference has no static ceiling (this_itermax+5/+10/+15,
+    # lmfit.c:936-953), but on TPU the RSD warmup is a static-length scan
+    # and the TR/NSD loops carry compile-time bounds, so the ceiling is an
+    # intentional compile-time/runtime tradeoff: raise it if profiling
+    # shows clusters exhausting their dynamic budget.
+    iter_budget_cap: int = struct.field(pytree_node=False, default=3)
 
 
 class ClusterData(NamedTuple):
@@ -105,6 +115,58 @@ def build_cluster_data(
             predict_coherencies(data.u, data.v, data.w, data.freqs, src, fdelta)
         )
         tilechunk = -(-data.tilesz // nch)  # ceil
+        cmap = jnp.minimum(data.time_idx // tilechunk, nch - 1).astype(jnp.int32)
+        cmaps.append(cmap)
+    return ClusterData(
+        coh=jnp.stack(cohs),
+        chunk_map=jnp.stack(cmaps),
+        nchunk=jnp.asarray(list(nchunks), jnp.int32),
+    )
+
+
+def build_cluster_data_withbeam(
+    data: VisData,
+    clusters: Sequence[SourceBatch],
+    nchunks: Sequence[int],
+    geom,
+    pointing,
+    coeff,
+    beam_mode: int,
+    time_jd,
+    ra0: float,
+    dec0: float,
+    fdelta: Optional[float] = None,
+    wideband: bool = False,
+) -> ClusterData:
+    """Beam-aware tile precompute: per cluster, evaluate the station beam
+    toward each source and fold it into the coherencies
+    (``precalculate_coherencies_withbeam``, predict_withbeam.c:552; the
+    per-source/station/time/freq beam precompute of :487-510).
+
+    ``geom``/``pointing``/``coeff``: see :mod:`sagecal_tpu.ops.beam`;
+    ``time_jd``: (tilesz,) Julian dates of the tile's timeslots; source
+    (ra, dec) are recovered from the batches' direction cosines about
+    (ra0, dec0)."""
+    from sagecal_tpu.ops.beam import beam_jones, predict_coherencies_withbeam
+    from sagecal_tpu.ops.transforms import lmn_to_radec
+
+    if fdelta is None:
+        fdelta = data.deltaf
+    cohs = []
+    cmaps = []
+    for src, nch in zip(clusters, nchunks):
+        ra, dec = lmn_to_radec(np.asarray(src.ll), np.asarray(src.mm), ra0, dec0)
+        B = beam_jones(
+            geom, pointing, coeff, ra, dec, np.asarray(time_jd),
+            jnp.asarray(data.freqs), mode=beam_mode, wideband=wideband,
+        ).astype(data.vis.dtype)
+        cohs.append(
+            predict_coherencies_withbeam(
+                data.u, data.v, data.w, data.freqs, src, B,
+                data.time_idx, data.ant_p, data.ant_q, fdelta,
+            )
+        )
+        tilechunk = -(-data.tilesz // nch)
         cmap = jnp.minimum(data.time_idx // tilechunk, nch - 1).astype(jnp.int32)
         cmaps.append(cmap)
     return ClusterData(
@@ -218,6 +280,11 @@ def sagefit(
                 (0.20 * nerr_k * total_iter).astype(jnp.int32) + iter_bar,
                 config.max_iter,
             )
+            # static ceilings sized from the max weighted budget the -R
+            # allocation can grant (iter_budget_cap * max_iter), not bare
+            # max_iter — otherwise the weighted-allocation feature would
+            # no-op in RTR/NSD modes (see SageConfig.iter_budget_cap)
+            iter_cap = config.max_iter * config.iter_budget_cap
             if mode == SM_RTR_OSLM_LBFGS:
                 # RTR every EM pass, weighted budget (lmfit.c:936:
                 # this_itermax+5 RSD, +10 TR)
@@ -225,8 +292,8 @@ def sagefit(
 
                 res = rtr_solve(
                     xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
-                    RTRConfig(itmax_rsd=config.max_iter + 5,
-                              itmax_rtr=config.max_iter + 10),
+                    RTRConfig(itmax_rsd=iter_cap + 5,
+                              itmax_rtr=iter_cap + 10),
                     itmax_dynamic=itermax,
                 )
                 return res.p, (_nerr_of(res), jnp.asarray(config.nulow, p_all.dtype))
@@ -237,8 +304,8 @@ def sagefit(
 
                 res, nu_k = rtr_solve_robust(
                     xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
-                    RTRConfig(itmax_rsd=config.max_iter + 5,
-                              itmax_rtr=config.max_iter + 10),
+                    RTRConfig(itmax_rsd=iter_cap + 5,
+                              itmax_rtr=iter_cap + 10),
                     nu0=nu_prev, nulow=config.nulow, nuhigh=config.nuhigh,
                     em_iters=config.em_rounds_robust,
                     itmax_dynamic=itermax,
@@ -250,7 +317,7 @@ def sagefit(
 
                 res, nu_k = nsd_solve_robust(
                     xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
-                    itmax=config.max_iter + 15,
+                    itmax=iter_cap + 15,
                     nu0=nu_prev, nulow=config.nulow, nuhigh=config.nuhigh,
                     em_iters=config.em_rounds_robust,
                     itmax_dynamic=itermax,

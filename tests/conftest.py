@@ -10,9 +10,19 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# 8 virtual devices time-share this host's core(s): shards reach
+# collectives far apart in wall-clock, and XLA CPU's rendezvous would
+# abort the process after ~40 s (observed with the robust-RTR ADMM
+# x-step).  Raise the limits for the whole suite.
+for f in (
+    "--xla_cpu_collective_timeout_seconds=7200",
+    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=600",
+    "--xla_cpu_collective_call_terminate_timeout_seconds=7200",
+):
+    if f.split("=")[0] not in flags:
+        flags = flags + " " + f
+os.environ["XLA_FLAGS"] = flags.strip()
 
 import jax  # noqa: E402
 

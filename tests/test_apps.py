@@ -33,7 +33,8 @@ def workdir(tmp_path):
     return tmp_path
 
 
-def _make_dataset(path, nstations=7, ntime=4, nchan=2, jones=None, seed=0):
+def _make_dataset(path, nstations=7, ntime=4, nchan=2, jones=None, seed=0,
+                  with_beam=False):
     """Dataset whose sky matches SKY above (phase center ra=0, dec=51d)."""
     from sagecal_tpu.io.skymodel import load_sky
     import tempfile
@@ -47,7 +48,7 @@ def _make_dataset(path, nstations=7, ntime=4, nchan=2, jones=None, seed=0):
     simulate_dataset(
         str(path), nstations=nstations, ntime=ntime, nchan=nchan,
         clusters=clusters, jones=jones, noise_sigma=1e-4, seed=seed,
-        dec0=math.radians(51.0),
+        dec0=math.radians(51.0), with_beam=with_beam,
     )
     # patch phase center attrs to match the sky model
     import h5py
@@ -159,6 +160,124 @@ class TestFullbatchApp:
         _, jsol = solio.read_solutions(str(workdir / "sol.txt"))
         eye = np.broadcast_to(np.eye(2), jsol[0].shape)
         np.testing.assert_allclose(jsol[0], eye, atol=1e-12)
+
+
+class TestBeamAndFlags:
+    def test_beam_mode_changes_coherencies(self, workdir):
+        """-B on vs off produce genuinely different cluster coherencies
+        (the doBeam dispatch, fullbatch_mode.cpp:371-388), and the
+        beam-aware calibration still runs end-to-end."""
+        from sagecal_tpu.apps.fullbatch import _beam_setup
+        from sagecal_tpu.io.skymodel import load_sky
+        from sagecal_tpu.solvers.sage import (
+            build_cluster_data, build_cluster_data_withbeam,
+        )
+
+        dsp = workdir / "d.h5"
+        jones = random_jones(2, 7, seed=3, amp=0.1, dtype=np.complex128)
+        _make_dataset(dsp, jones=jones, with_beam=True)
+        clusters, _ = load_sky(
+            str(workdir / "t.sky.txt"), str(workdir / "t.sky.txt.cluster"),
+            0.0, math.radians(51.0), dtype=np.float64,
+        )
+        cfg = RunConfig(
+            dataset=str(dsp), sky_model=str(workdir / "t.sky.txt"),
+            cluster_file=str(workdir / "t.sky.txt.cluster"),
+            out_solutions=str(workdir / "sol.txt"),
+            tilesz=4, max_emiter=2, max_iter=6, max_lbfgs=10,
+            solver_mode=1, beam_mode=2,  # ref code 2 = array+element
+        )
+        with VisDataset(str(dsp)) as ds:
+            data = ds.load_tile(0, 4, average_channels=True)
+            geom, pointing, coeff, mode, wb = _beam_setup(cfg, ds)
+            cd_plain = build_cluster_data(data, clusters, [1, 1])
+            cd_beam = build_cluster_data_withbeam(
+                data, clusters, [1, 1], geom, pointing, coeff, mode,
+                ds.time_jd(0, 4), 0.0, math.radians(51.0),
+            )
+        diff = float(
+            jnp.linalg.norm((cd_plain.coh - cd_beam.coh).ravel())
+            / jnp.linalg.norm(cd_plain.coh.ravel())
+        )
+        assert diff > 1e-3, diff
+        # full beam-aware run completes with a sane residual trace
+        results = run_fullbatch(cfg, log=lambda *a: None)
+        assert len(results) == 1
+        assert np.isfinite(results[0][1])
+
+    def test_beam_mode_requires_beam_group(self, workdir):
+        dsp = workdir / "d.h5"
+        _make_dataset(dsp, with_beam=False)
+        cfg = RunConfig(
+            dataset=str(dsp), sky_model=str(workdir / "t.sky.txt"),
+            cluster_file=str(workdir / "t.sky.txt.cluster"),
+            beam_mode=1,
+        )
+        with pytest.raises(ValueError, match="beam"):
+            run_fullbatch(cfg, log=lambda *a: None)
+
+    def test_per_channel_refit(self, workdir):
+        """-b: per-channel re-fit lowers the per-channel residual vs the
+        averaged-solution residual when gains vary across channels."""
+        dsp = workdir / "d.h5"
+        jones = random_jones(2, 7, seed=9, amp=0.15, dtype=np.complex128)
+        _make_dataset(dsp, nchan=2, jones=jones)
+        base = dict(
+            dataset=str(dsp), sky_model=str(workdir / "t.sky.txt"),
+            cluster_file=str(workdir / "t.sky.txt.cluster"),
+            tilesz=4, max_emiter=2, max_iter=6, max_lbfgs=12,
+            solver_mode=1,
+        )
+        cfg = RunConfig(out_solutions=str(workdir / "s1.txt"),
+                        per_channel=True, **base)
+        run_fullbatch(cfg, log=lambda *a: None)
+        with VisDataset(str(dsp)) as ds:
+            res_pc = np.asarray(ds._f["corrected"])
+        cfg2 = RunConfig(out_solutions=str(workdir / "s2.txt"), **base)
+        run_fullbatch(cfg2, log=lambda *a: None)
+        with VisDataset(str(dsp)) as ds:
+            res_avg = np.asarray(ds._f["corrected"])
+        # per-channel refit should not be worse
+        assert np.linalg.norm(res_pc) <= np.linalg.norm(res_avg) * 1.05
+
+    def test_skip_and_max_tiles(self, workdir):
+        dsp = workdir / "d.h5"
+        jones = random_jones(2, 7, seed=3, amp=0.1, dtype=np.complex128)
+        _make_dataset(dsp, ntime=4, jones=jones)
+        base = dict(
+            dataset=str(dsp), sky_model=str(workdir / "t.sky.txt"),
+            cluster_file=str(workdir / "t.sky.txt.cluster"),
+            tilesz=2, max_emiter=1, max_iter=4, max_lbfgs=6, solver_mode=1,
+        )
+        r_all = run_fullbatch(
+            RunConfig(out_solutions=str(workdir / "sa.txt"), **base),
+            log=lambda *a: None,
+        )
+        assert len(r_all) == 2
+        r_skip = run_fullbatch(
+            RunConfig(out_solutions=str(workdir / "sb.txt"),
+                      skip_tiles=1, **base),
+            log=lambda *a: None,
+        )
+        assert len(r_skip) == 1
+        r_lim = run_fullbatch(
+            RunConfig(out_solutions=str(workdir / "sc.txt"),
+                      max_tiles=1, **base),
+            log=lambda *a: None,
+        )
+        assert len(r_lim) == 1
+
+    def test_rho_file(self, tmp_path):
+        from sagecal_tpu.io.skymodel import parse_clusters, read_cluster_rho
+
+        (tmp_path / "c.txt").write_text("1 1 A\n2 2 B\n")
+        cdefs = parse_clusters(str(tmp_path / "c.txt"))
+        (tmp_path / "rho.txt").write_text(
+            "# cluster_id hybrid rho\n2 2 7.5\n1 1 3.0\n"
+        )
+        rho, alpha = read_cluster_rho(str(tmp_path / "rho.txt"), cdefs)
+        np.testing.assert_allclose(rho, [3.0, 7.5])
+        assert alpha is None
 
 
 class TestMinibatchApp:
