@@ -76,6 +76,26 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--f32", action="store_true",
                     help="solve in float32 (TPU-native precision)")
     ap.add_argument("-V", "--verbose", action="store_true")
+    # distributed (sagecal-mpi) surface: -f pattern selects the mesh
+    # driver (MPI/main.cpp:336; master MS discovery :60-224)
+    ap.add_argument("-f", "--band-pattern", default=None,
+                    help="glob of per-band vis.h5 datasets -> distributed "
+                    "consensus-ADMM over the device mesh (ref sagecal-mpi "
+                    "-f 'pattern')")
+    ap.add_argument("--multihost", action="store_true",
+                    help="call jax.distributed.initialize() for multi-host "
+                    "meshes (DCN)")
+    ap.add_argument("-U", "--spatial-n0", type=int, default=0,
+                    help=">0 enables spatial regularization of Z with a "
+                    "shapelet basis of this order (ref -U)")
+    ap.add_argument("--spatial-beta", type=float, default=0.01)
+    ap.add_argument("--spatial-mu", type=float, default=1e-3)
+    ap.add_argument("-O", "--spatial-cadence", type=int, default=2,
+                    help="run the spatial FISTA update every this many "
+                    "ADMM iterations (ref admm_cadence)")
+    ap.add_argument("-i", "--influence", action="store_true",
+                    help="write influence-function diagnostics instead of "
+                    "residuals (ref -i)")
     return ap
 
 
@@ -117,6 +137,7 @@ def config_from_args(args) -> RunConfig:
         admm_rho=args.admm_rho,
         use_f64=not args.f32,
         verbose=args.verbose,
+        influence=args.influence,
     )
 
 
@@ -129,8 +150,21 @@ def main(argv=None):
         return 0
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
-    # mode dispatch (main.cpp:295-307)
-    if cfg.epochs > 0:
+    # mode dispatch (main.cpp:295-307; -f selects the sagecal-mpi
+    # equivalent, MPI/main.cpp:336)
+    if args.band_pattern:
+        from sagecal_tpu.apps.distributed import run_distributed
+
+        cfg.dataset = args.band_pattern
+        run_distributed(
+            cfg, multihost=args.multihost,
+            nadmm=max(cfg.admm_iters, 2),
+            spatial_n0=args.spatial_n0,
+            spatial_beta=args.spatial_beta,
+            spatial_mu=args.spatial_mu,
+            spatial_cadence=args.spatial_cadence,
+        )
+    elif cfg.epochs > 0:
         from sagecal_tpu.apps.minibatch import run_minibatch
 
         run_minibatch(cfg)

@@ -61,6 +61,9 @@ class RunConfig:
     max_tiles: int = 0  # 0 = no limit
     # divergence guard (fullbatch_mode.cpp:250,618-632)
     res_ratio: float = 5.0
+    # influence-function diagnostics in place of residuals (-i,
+    # diagnostics.c / fullbatch_mode.cpp:526-534)
+    influence: bool = False
     # precision
     use_f64: bool = True
     verbose: bool = False  # -V

@@ -18,7 +18,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from sagecal_tpu.apps.config import RunConfig
-from sagecal_tpu.core.types import identity_jones, jones_to_params, params_to_jones
+from sagecal_tpu.core.types import (
+    identity_jones,
+    jones_to_params,
+    mat_of_flat,
+    params_to_jones,
+)
 from sagecal_tpu.io import solutions as solio
 from sagecal_tpu.io.dataset import VisDataset
 from sagecal_tpu.io.skymodel import load_sky
@@ -79,11 +84,18 @@ def _beam_setup(cfg: RunConfig, ds: VisDataset):
     geom, pointing = bp
     coeff = None
     if mode != DOBEAM_ARRAY:
-        coeff = (
-            ElementCoeffs.load(cfg.element_coeffs)
-            if cfg.element_coeffs
-            else synthetic_dipole_coeffs()
-        )
+        if cfg.element_coeffs:
+            # 'lba'/'hba'/'alo' (or a table npz) -> real coefficient
+            # tables interpolated to the observing frequency; plain npz
+            # -> the single-frequency loadable format
+            try:
+                coeff = ElementCoeffs.from_table(
+                    cfg.element_coeffs, ds.meta.freq0
+                )
+            except (KeyError, FileNotFoundError):
+                coeff = ElementCoeffs.load(cfg.element_coeffs)
+        else:
+            coeff = synthetic_dipole_coeffs()
     return geom, pointing, coeff, mode, wideband
 
 
@@ -148,6 +160,15 @@ def run_fullbatch(cfg: RunConfig, log=print):
             fdelta=fdelta, wideband=wideband,
         )
 
+    # first-class profiling (SURVEY section 5): per-phase wall-clock
+    # always on; SAGECAL_PROFILE_DIR additionally captures an XLA trace
+    from sagecal_tpu.utils.profiling import PhaseTimer, start_trace, stop_trace
+
+    timer = PhaseTimer()
+    trace_dir = start_trace()
+    if trace_dir:
+        log(f"profiling: XLA trace -> {trace_dir}")
+
     results = []
     ntiles_done = 0
     for tile_no, t0 in enumerate(ds.tiles(cfg.tilesz)):
@@ -158,11 +179,15 @@ def run_fullbatch(cfg: RunConfig, log=print):
             break
         ntiles_done += 1
         tic = time.time()
-        full = ds.load_tile(
-            t0, cfg.tilesz, average_channels=False,
-            min_uvcut=cfg.min_uvcut, max_uvcut=cfg.max_uvcut, dtype=dtype,
-        )
-        cdata_full = _cdata(full, t0, fdelta=meta.deltaf / max(meta.nchan, 1))
+        with timer.phase("load"):
+            full = ds.load_tile(
+                t0, cfg.tilesz, average_channels=False,
+                min_uvcut=cfg.min_uvcut, max_uvcut=cfg.max_uvcut, dtype=dtype,
+            )
+        with timer.phase("coherencies"):
+            cdata_full = _cdata(
+                full, t0, fdelta=meta.deltaf / max(meta.nchan, 1)
+            )
 
         if cfg.simulation_mode:
             # predict / add / subtract (fullbatch_mode.cpp:536-591);
@@ -180,22 +205,25 @@ def run_fullbatch(cfg: RunConfig, log=print):
                 ignore_clusters=ignore_idx, ccid_index=ccid_index,
                 rho=cfg.correction_rho, phase_only=cfg.phase_only_correction,
             )
-            ds.write_tile(t0, np.asarray(out_vis), column="model")
+            ds.write_tile(t0, np.asarray(mat_of_flat(out_vis)), column="model")
             log(f"tile {t0}: simulated ({time.time()-tic:.1f}s)")
             continue
 
-        data = ds.load_tile(
-            t0, cfg.tilesz, average_channels=True,
-            min_uvcut=cfg.min_uvcut, max_uvcut=cfg.max_uvcut, dtype=dtype,
-        )
+        with timer.phase("load"):
+            data = ds.load_tile(
+                t0, cfg.tilesz, average_channels=True,
+                min_uvcut=cfg.min_uvcut, max_uvcut=cfg.max_uvcut, dtype=dtype,
+            )
         if cfg.whiten:
             wts = jnp.sqrt(whiten_uv_weights(data.u, data.v, meta.freq0))
-            data = data.replace(vis=data.vis * wts[:, None, None, None],
-                                mask=data.mask * (wts[:, None] > 0))
-        cdata = _cdata(data, t0)
+            data = data.replace(vis=data.vis * wts[None, None, :],
+                                mask=data.mask * (wts[None, :] > 0))
+        with timer.phase("coherencies"):
+            cdata = _cdata(data, t0)
 
-        out = sagefit(data, cdata, p, scfg)
-        res0, res1 = float(out.res_0), float(out.res_1)
+        with timer.phase("solve"):
+            out = sagefit(data, cdata, p, scfg)
+            res0, res1 = float(out.res_0), float(out.res_1)
         # divergence guard (fullbatch_mode.cpp:618-632)
         diverged = (
             not np.isfinite(res1) or res1 == 0.0 or res1 > cfg.res_ratio * res0
@@ -208,6 +236,22 @@ def run_fullbatch(cfg: RunConfig, log=print):
         jsol = np.asarray(params_to_jones(p)).reshape(M * nchunk_max, N, 2, 2)
         solio.append_solutions(sol_fh, jsol)
 
+        if cfg.influence:
+            # -i: influence function replaces the residuals
+            # (fullbatch_mode.cpp:526-534 -> calculate_diagnostics_gpu)
+            from sagecal_tpu.ops.diagnostics import influence_function
+
+            infl = influence_function(full, cdata_full, p)  # host numpy
+            # host-side flat -> (rows, F, 2, 2) (no device round trip)
+            infl_mat = np.moveaxis(infl, -1, 0).reshape(
+                infl.shape[-1], infl.shape[0], 2, 2
+            )
+            ds.write_tile(t0, infl_mat, column="influence")
+            log(f"tile {t0}: influence diagnostics written "
+                f"({time.time()-tic:.1f}s)")
+            results.append((float(out.res_0), float(out.res_1)))
+            continue
+
         if cfg.per_channel and meta.nchan > 1:
             # -b: per-channel joint-LBFGS re-fit from the averaged
             # solution, residuals per channel with each channel's own
@@ -215,16 +259,16 @@ def run_fullbatch(cfg: RunConfig, log=print):
             from sagecal_tpu.solvers.batchmode import bfgsfit_minibatch
 
             res_np = np.empty(
-                (full.vis.shape[0], meta.nchan, 2, 2),
+                (full.vis.shape[-1], meta.nchan, 2, 2),
                 np.complex128 if cfg.use_f64 else np.complex64,
             )
             for c in range(meta.nchan):
                 dc = full.replace(
-                    vis=full.vis[:, c:c + 1],
-                    mask=full.mask[:, c:c + 1],
+                    vis=full.vis[c:c + 1],
+                    mask=full.mask[c:c + 1],
                     freqs=full.freqs[c:c + 1],
                 )
-                cc = cdata_full._replace(coh=cdata_full.coh[:, :, c:c + 1])
+                cc = cdata_full._replace(coh=cdata_full.coh[:, c:c + 1])
                 p_c, _ = bfgsfit_minibatch(
                     dc, cc, p, itmax=cfg.max_lbfgs, lbfgs_m=cfg.lbfgs_m,
                 )
@@ -233,21 +277,27 @@ def run_fullbatch(cfg: RunConfig, log=print):
                     rho=cfg.correction_rho,
                     phase_only=cfg.phase_only_correction,
                 )
-                res_np[:, c] = np.asarray(res_c)[:, 0]
+                res_np[:, c] = np.asarray(mat_of_flat(res_c))[:, 0]
             res = res_np
         else:
             # residuals on the full-channel data, optional correction
-            res = calculate_residuals(
-                full, cdata_full, p, ccid_index=ccid_index,
-                rho=cfg.correction_rho, phase_only=cfg.phase_only_correction,
-            )
-        ds.write_tile(t0, np.asarray(res), column="corrected")
+            with timer.phase("residual"):
+                res = np.asarray(mat_of_flat(calculate_residuals(
+                    full, cdata_full, p, ccid_index=ccid_index,
+                    rho=cfg.correction_rho,
+                    phase_only=cfg.phase_only_correction,
+                )))
+        with timer.phase("write"):
+            ds.write_tile(t0, np.asarray(res), column="corrected")
         log(
             f"tile {t0}: residual {res0:.6f} -> {res1:.6f} "
-            f"nu {float(out.mean_nu):.1f} ({time.time()-tic:.1f}s)"
+            f"nu {float(out.mean_nu):.1f} ({time.time()-tic:.1f}s) "
+            f"[{timer.tile_summary()}]"
         )
         results.append((res0, res1))
 
+    log(timer.run_summary())
+    stop_trace()
     if sol_fh:
         sol_fh.close()
     ds.close()

@@ -42,10 +42,11 @@ def _band_slices(nchan: int, bands: int):
 
 
 def _band_visdata(full, c0, c1):
-    """Restrict a multichannel VisData to channels [c0, c1)."""
+    """Restrict a multichannel VisData to channels [c0, c1) — the flat
+    layout's channel axis is leading."""
     return full.replace(
-        vis=full.vis[:, c0:c1],
-        mask=full.mask[:, c0:c1],
+        vis=full.vis[c0:c1],
+        mask=full.mask[c0:c1],
         freqs=full.freqs[c0:c1],
     )
 
@@ -195,12 +196,14 @@ def run_minibatch(cfg: RunConfig, log=print):
         if t1 <= t0:
             continue
         full = ds.load_tile(t0, t1 - t0, average_channels=False, dtype=dtype)
-        res_all = np.array(np.asarray(full.vis), copy=True)
+        from sagecal_tpu.core.types import mat_of_flat
+
+        res_all = np.array(np.asarray(mat_of_flat(full.vis)), copy=True)
         for bi, (c0, c1) in enumerate(bands):
             db = _band_visdata(full, c0, c1)
             cb = build_cluster_data(db, clusters, nchunks, fdelta=fd)
             res = calculate_residuals(db, cb, p_bands[bi])
-            res_all[:, c0:c1] = np.asarray(res)
+            res_all[:, c0:c1] = np.asarray(mat_of_flat(res))
             acc[bi][0] += float(jnp.sum(jnp.abs(db.vis) ** 2))
             acc[bi][1] += float(jnp.sum(jnp.abs(res) ** 2))
         ds.write_tile(t0, res_all, column="corrected")

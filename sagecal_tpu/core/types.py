@@ -6,10 +6,22 @@ The reference stores a visibility row as 8 doubles (XX,XY,YX,YY x re,im;
 ordering documented at ``/root/reference/src/lib/Dirac/Dirac.h:1617-1618``)
 and a station's Jones solution as 8 reals ``S0..S7`` with
 ``J = [S0+jS1, S4+jS5; S2+jS3, S6+jS7]`` (``/root/reference/README.md``
-section 6).  Here visibilities are native complex arrays of shape
-``(rows, nchan, 2, 2)`` — the 2x2 coherency matrix is a trailing axis so
-XLA batches the tiny matmuls of the RIME (J_p C J_q^H) across rows on the
-MXU/VPU — and Jones solutions are ``(..., nstations, 2, 2)`` complex.  The
+section 6).
+
+**Canonical visibility layout — rows minor-most.**  Visibilities,
+coherencies, models and residuals are complex arrays of shape
+``(..., F, 4, rows)``: channel, then the four coherency components
+``[XX, XY, YX, YY]`` (the 2x2 matrix row-major), with the long
+``rows = nbase * tilesz`` axis LAST.  This is the TPU-native choice: XLA
+tiles the two minor-most dims to (8, 128) lanes, so a trailing 2x2 matrix
+axis would pad every visibility buffer 64x (measured: the 62-station/
+100-cluster tile's 726 MB coherency stack became a 46.47 GB allocation),
+while rows-minor layouts pad only the tail of the rows axis.  The RIME's
+tiny 2x2 matrix products are expanded into explicit component arithmetic
+(:func:`corrupt_flat`): elementwise VPU math vectorized along the rows
+lane axis, which is both layout-friendly and faster than gathering
+per-row 2x2 matrices (2x2 matmuls never reach the MXU anyway).  Jones
+solutions stay ``(..., nstations, 2, 2)`` complex — they are small.  The
 8-real S-ordering only exists at the text-file boundary
 (:mod:`sagecal_tpu.io.solutions`) for byte-compatibility with the
 reference's solution format.
@@ -50,8 +62,10 @@ class VisData:
     Attributes:
       u, v, w:  (rows,) baseline coordinates in *seconds* (metres / c).
       ant_p, ant_q: (rows,) int32 station indices of each baseline.
-      vis: (rows, nchan, 2, 2) complex observed coherencies.
-      mask: (rows, nchan) 1.0 = good, 0.0 = flagged. Multiplicative, so
+      vis: (nchan, 4, rows) complex observed coherencies, components
+        [XX, XY, YX, YY] on axis -2 (see module docstring for why rows
+        is minor-most).
+      mask: (nchan, rows) 1.0 = good, 0.0 = flagged. Multiplicative, so
         flagged rows contribute zero to every residual/gradient reduction
         (replaces the reference's preset_flags_and_data zeroing,
         ``/root/reference/src/lib/Dirac/baseline_utils.c``).
@@ -87,7 +101,7 @@ class VisData:
 
     @property
     def nchan(self) -> int:
-        return self.vis.shape[1]
+        return self.vis.shape[-3]
 
 
 @struct.dataclass
@@ -166,10 +180,12 @@ def mat2x2_inv(m: jax.Array) -> jax.Array:
 
 
 def apply_gains(jones: jax.Array, coh: jax.Array, ant_p: jax.Array, ant_q: jax.Array) -> jax.Array:
-    """The RIME corruption  V_pq = J_p C_pq J_q^H.
+    """The RIME corruption  V_pq = J_p C_pq J_q^H on SMALL mat-form arrays.
 
     jones: (N, 2, 2) complex; coh: (rows, ..., 2, 2); ant_p/ant_q: (rows,).
-    Batched 2x2 matmuls — XLA lowers these to MXU-batched GEMMs.
+    Prefer :func:`corrupt_flat` for canonical flat-layout data — this
+    trailing-2x2 form is kept for small per-source/per-station arrays
+    (beam tables, tests).
     """
     jp = jones[ant_p]  # (rows, 2, 2)
     jq = jones[ant_q]
@@ -178,3 +194,115 @@ def apply_gains(jones: jax.Array, coh: jax.Array, ant_p: jax.Array, ant_q: jax.A
         jp = jp[:, None]
         jq = jq[:, None]
     return jp @ coh @ herm(jq)
+
+
+# ---------------------------------------------------------------------------
+# canonical flat (F, 4, rows) layout: converters + component-wise RIME
+# ---------------------------------------------------------------------------
+
+def flat_of_mat(x: jax.Array) -> jax.Array:
+    """(rows, F, 2, 2) matrix-form block -> canonical (F, 4, rows) flat."""
+    rows, F = x.shape[0], x.shape[1]
+    return jnp.moveaxis(x.reshape(rows, F, 4), 0, -1)
+
+
+def mat_of_flat(x: jax.Array) -> jax.Array:
+    """Canonical (..., F, 4, rows) flat block -> (..., rows, F, 2, 2).
+
+    Boundary/test helper only — materializing the trailing-2x2 form for a
+    large rows axis on TPU re-creates the 64x tile-padding this layout
+    exists to avoid.
+    """
+    rows = x.shape[-1]
+    y = jnp.moveaxis(x, -1, -3)  # (..., rows, F, 4)
+    return y.reshape(y.shape[:-1] + (2, 2))
+
+
+def reals_of_flat(x: jax.Array) -> jax.Array:
+    """Complex flat block (..., 4, rows) -> real (..., 8, rows):
+    [Re XX, Im XX, Re XY, Im XY, Re YX, Im YX, Re YY, Im YY] on axis -2
+    (the reference's 8-double row ordering, Dirac.h:1617-1618)."""
+    r = jnp.stack([jnp.real(x), jnp.imag(x)], axis=-2)  # (..., 4, 2, rows)
+    return r.reshape(x.shape[:-2] + (8, x.shape[-1]))
+
+
+def flat_of_reals(r: jax.Array) -> jax.Array:
+    """Inverse of :func:`reals_of_flat`."""
+    s = r.reshape(r.shape[:-2] + (4, 2, r.shape[-1]))
+    return jax.lax.complex(s[..., 0, :], s[..., 1, :])
+
+
+def gather_jones_rows(jones: jax.Array, ant: jax.Array, chunk_map: Optional[jax.Array] = None):
+    """Per-row Jones components via a one-hot MATMUL, not a gather.
+
+    jones: (N, 2, 2) or (nchunk, N, 2, 2) complex; ant: (rows,) station
+    index; chunk_map: (rows,) hybrid-chunk index (required iff jones has
+    a chunk axis).  Returns (j00, j01, j10, j11), each (rows,) complex.
+
+    TPU note: XLA gathers over a long rows axis run ~100 ms at the
+    62-station/60-timeslot tile (and their scatter-add transpose in the
+    backward pass is worse) — measured 173 ms fwd+bwd per gather vs
+    6.6 ms for the equivalent one-hot matmul, which also lands on the
+    MXU.  The station table is tiny, so the (rows, K) one-hot is the
+    cheap side of a skinny GEMM.
+    """
+    if jones.ndim == 3:
+        K = jones.shape[0]
+        idx = ant
+    else:
+        nchunk, N = jones.shape[0], jones.shape[1]
+        K = nchunk * N
+        idx = (chunk_map * N + ant) if chunk_map is not None else ant
+    tab = jones.reshape(K, 4)  # row-major comps [00, 01, 10, 11]
+    rdt = jnp.real(tab).dtype
+    oh = (idx[:, None] == jnp.arange(K, dtype=idx.dtype)[None, :]).astype(rdt)
+    v = jax.lax.complex(oh @ jnp.real(tab), oh @ jnp.imag(tab))  # (rows, 4)
+    return v[:, 0], v[:, 1], v[:, 2], v[:, 3]
+
+
+def corrupt_flat(
+    jones: jax.Array,
+    coh: jax.Array,
+    ant_p: jax.Array,
+    ant_q: jax.Array,
+    chunk_map: Optional[jax.Array] = None,
+) -> jax.Array:
+    """The RIME corruption V = J_p C J_q^H in canonical flat layout.
+
+    jones: (N, 2, 2) or (nchunk, N, 2, 2) complex; coh: (..., F, 4, rows);
+    ant_p/ant_q/chunk_map: (rows,).  Returns (..., F, 4, rows).
+
+    Expanded 2x2 component arithmetic — elementwise over the rows lane
+    axis (the pthread-per-baseline loop of predict.c:110-260 and the
+    one-thread-per-baseline kernel of predict_model.cu:1060 both
+    dissolve into this single vectorized expression).
+    """
+    return corrupt_flat_2sided(jones, jones, coh, ant_p, ant_q, chunk_map)
+
+
+def corrupt_flat_2sided(
+    jones_p: jax.Array,
+    jones_q: jax.Array,
+    coh: jax.Array,
+    ant_p: jax.Array,
+    ant_q: jax.Array,
+    chunk_map: Optional[jax.Array] = None,
+) -> jax.Array:
+    """V = G_p C H_q^H with distinct left/right Jones stacks (used by the
+    residual-correction path where G = H = inv(J_ccid))."""
+    pa, pb, pc, pd = gather_jones_rows(jones_p, ant_p, chunk_map)
+    qa, qb, qc, qd = gather_jones_rows(jones_q, ant_q, chunk_map)
+    qa, qb, qc, qd = jnp.conj(qa), jnp.conj(qb), jnp.conj(qc), jnp.conj(qd)
+    c00 = coh[..., 0, :]
+    c01 = coh[..., 1, :]
+    c10 = coh[..., 2, :]
+    c11 = coh[..., 3, :]
+    t00 = pa * c00 + pb * c10
+    t01 = pa * c01 + pb * c11
+    t10 = pc * c00 + pd * c10
+    t11 = pc * c01 + pd * c11
+    v00 = t00 * qa + t01 * qb
+    v01 = t00 * qc + t01 * qd
+    v10 = t10 * qa + t11 * qb
+    v11 = t10 * qc + t11 * qd
+    return jnp.stack([v00, v01, v10, v11], axis=-2)

@@ -147,14 +147,18 @@ class VisDataset:
             mask = ((~flag) & (~uvcut_bad[:, None])).astype(dtype)
             freqs = m.freqs
             fd = m.deltaf / max(m.nchan, 1)
+        # -> canonical flat (F, 4, rows) / (F, rows) device layout
+        nch = x.shape[1]
+        x_flat = np.moveaxis(x.reshape(rows, nch, 4), 0, -1)
+        mask_flat = np.moveaxis(mask, 0, -1)
         return VisData(
             u=jnp.asarray(u / C0, dtype),
             v=jnp.asarray(v / C0, dtype),
             w=jnp.asarray(w / C0, dtype),
             ant_p=jnp.asarray(ant_p),
             ant_q=jnp.asarray(ant_q),
-            vis=jnp.asarray(x, cdtype),
-            mask=jnp.asarray(mask, dtype),
+            vis=jnp.asarray(x_flat, cdtype),
+            mask=jnp.asarray(mask_flat, dtype),
             freqs=jnp.asarray(freqs, dtype),
             time_idx=jnp.asarray(time_idx),
             freq0=m.freq0,
@@ -301,13 +305,15 @@ def simulate_dataset(
     freqs = freq0 + chan_bw * (np.arange(nchan) - (nchan - 1) / 2.0)
     rng = np.random.default_rng(seed)
     if clusters is not None:
+        from sagecal_tpu.core.types import mat_of_flat
+
         visr = predict_model(
             jnp.asarray(us), jnp.asarray(vs), jnp.asarray(ws),
             jnp.asarray(freqs, np.float64), clusters, 0.0,
             jones=jones,
             ant_p=jnp.asarray(ap), ant_q=jnp.asarray(aq),
         )
-        visr = np.asarray(visr)
+        visr = np.asarray(mat_of_flat(visr))  # (rows, nchan, 2, 2) on disk
     else:
         visr = np.zeros((ntime * nbase, nchan, 2, 2), np.complex128)
     if noise_sigma > 0:

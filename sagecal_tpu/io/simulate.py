@@ -78,8 +78,8 @@ def make_visdata(
         w=jnp.asarray(w, dtype),
         ant_p=jnp.asarray(ant_p),
         ant_q=jnp.asarray(ant_q),
-        vis=jnp.zeros((rows, nchan, 2, 2), cdtype),
-        mask=jnp.ones((rows, nchan), dtype),
+        vis=jnp.zeros((nchan, 4, rows), cdtype),
+        mask=jnp.ones((nchan, rows), dtype),
         freqs=jnp.asarray(freqs, dtype),
         time_idx=jnp.asarray(time_idx),
         freq0=float(freq0),

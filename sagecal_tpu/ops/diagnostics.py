@@ -1,0 +1,209 @@
+"""Influence-function diagnostics: the ``-i`` flag.
+
+Redesign of ``calculate_diagnostics_gpu``
+(``/root/reference/src/lib/Radio/diagnostics.c:1040-1182``, kernels
+``influence_function.cu:84-505``, decl ``Dirac_radio.h:668-709``):
+instead of residuals, write the *influence function* of the calibration
+— how strongly a perturbation of the visibility on one baseline leaks
+into the residual of every baseline through the solved gains — so users
+can identify baselines whose data dominate (or are suppressed by) the
+direction-dependent solutions.
+
+Math (per cluster k, at the solved gains; first channel only, F==1 as
+in the reference):
+
+1. ``H = d g / d vec(J)`` where ``g = df/d conj(vec(J))`` is the
+   Wirtinger gradient of the data misfit ``f = sum ||V - J_p C J_q^H||^2``
+   over the station-stacked ``X in C^{2N x 2}`` (column-major vec,
+   4N complex).  Blocks per baseline (p, q)  [kernel_hessian]:
+     (col p, row p) += kron(((C J_q^H)(C J_q^H)^H)^T, I_2)
+     (col q, row q) += kron(((J_p C)^H (J_p C))^T,   I_2)
+     (col q, row p) += kron(-conj(C), R)
+     (col p, row q) += kron(-C^T,     R^H)
+   Small diagonal entries are conditioned to 1, and with consensus info
+   (rho, Bpoly, Binv) the spectral-constraint curvature
+   ``0.5 rho Fd1`` is added to the diagonal (diagnostics.c:716-748).
+2. ``AdV[:, b] = sum_t vec((1+j) ones(2,2) (J_q C^H))`` at station-p row
+   blocks — the gradient perturbation from nudging every element of
+   V on per-timeslot baseline b by (1+j)  [kernel_d_solutions].
+3. ``U = lstsq(H, AdV)`` — the gain sensitivity dJ/dV
+   (diagnostics.c my_cgels call).
+4. ``dR[b', b] += vec(-U_p(b) (sum_t C J_q^H))`` on rows b' sharing
+   station p — the residual change on baseline b' from the perturbation
+   on b  [kernel_d_residuals; only the sta1 (p) block, as the kernel].
+5. Per correlation c in the vec order [00, 10, 01, 11]: eigenvalues of
+   the (Nbase x Nbase) complex matrix ``dR[:, :, c]`` replace the
+   residuals: baseline b's 8 reals become
+   [Re l_0(b), Im l_0(b), ..., Re l_3(b), Im l_3(b)], replicated over
+   the tile's timeslots  [find_eigenvalues, diagnostics.c:847-1010].
+
+The pthread/2-GPU fan-out and hand-written kron kernels of the
+reference dissolve into batched einsums + one scatter-add; the
+non-Hermitian eigensolve runs on the host (np.linalg.eigvals) because
+XLA's TPU backend has no general eig — matching the reference, which
+also hands this step to a solver library (cusolverDnXgeev).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sagecal_tpu.core.types import VisData, params_to_jones
+from sagecal_tpu.solvers.sage import ClusterData, predict_full_model
+
+
+def _kron4(A, B):
+    """Batched np.kron for (rows, 2, 2) blocks -> (rows, 4, 4)."""
+    return jnp.einsum("rab,rij->raibj", A, B).reshape(A.shape[0], 4, 4)
+
+
+def _vec_idx_assemble(blocks_colrow, N):
+    """(N, N, 4, 4) station blocks [col_sta, row_sta] -> (4N, 4N) with the
+    column-major vec(X) layout: index(c, s, r) = c*2N + 2 s + r."""
+    # blocks[m, n, 2c1+r1, 2c2+r2] -> H[c1*2N+2n+r1, c2*2N+2m+r2]
+    b = blocks_colrow.reshape(N, N, 2, 2, 2, 2)  # (m, n, c1, r1, c2, r2)
+    H = jnp.transpose(b, (2, 1, 3, 4, 0, 5))  # (c1, n, r1, c2, m, r2)
+    return H.reshape(4 * N, 4 * N)
+
+
+def _cluster_hessian(C, R, Jp, Jq, ant_p, ant_q, N):
+    """H = dg/dvec(J): (4N, 4N) complex  [kernel_hessian].
+
+    C/R: (rows, 2, 2) coherency + residual; Jp/Jq: (rows, 2, 2) per-row
+    gains (already chunk-gathered).
+    """
+    rows = C.shape[0]
+    herm = lambda m: jnp.conj(jnp.swapaxes(m, -1, -2))
+    CJqH = C @ herm(Jq)  # (rows, 2, 2)
+    JpC = Jp @ C
+    Mpp = CJqH @ herm(CJqH)
+    Mqq = herm(JpC) @ JpC
+    I2 = jnp.broadcast_to(jnp.eye(2, dtype=C.dtype), (rows, 2, 2))
+    Bpp = _kron4(jnp.swapaxes(Mpp, -1, -2), I2)
+    Bqq = _kron4(jnp.swapaxes(Mqq, -1, -2), I2)
+    Bqp = _kron4(-jnp.conj(C), R)  # (col q, row p)
+    Bpq = _kron4(-jnp.swapaxes(C, -1, -2), herm(R))  # (col p, row q)
+    blocks = jnp.zeros((N, N, 4, 4), C.dtype)
+    blocks = blocks.at[ant_p, ant_p].add(Bpp)
+    blocks = blocks.at[ant_q, ant_q].add(Bqq)
+    blocks = blocks.at[ant_q, ant_p].add(Bqp)
+    blocks = blocks.at[ant_p, ant_q].add(Bpq)
+    return _vec_idx_assemble(blocks, N)
+
+
+def _condition_diag(H, extra=0.0):
+    """Flagged stations leave 0 on the diagonal -> set to 1; optionally
+    add the consensus curvature (diagnostics.c:710-748)."""
+    d = jnp.diagonal(H)
+    d1 = jnp.where(jnp.abs(d) < 1e-5, 1.0 + 0.0j, d) + extra
+    return H - jnp.diag(d) + jnp.diag(d1)
+
+
+def consensus_hessian_addition(rho_k, Bpoly, Binv_k):
+    """0.5 * rho * Fd1 diagonal addition from the frequency-consensus
+    constraint (diagnostics.c:716-748; analysis_uvwdir.m ln 170-180).
+
+    Bpoly: (Npoly,) this band's basis row; Binv_k: (Npoly, Npoly)
+    per-cluster pseudo-inverse of sum_f rho_f B_f B_f^T.
+    """
+    bfBibf = Bpoly @ (Binv_k @ Bpoly)
+    Fd = 1.0 - bfBibf
+    Fdd = Fd * Fd
+    Fd1 = Fdd * (1.0 + Fdd / jnp.maximum(1.0 - Fdd, 1e-12))
+    return 0.5 * rho_k * Fd1
+
+
+def influence_function(
+    data: VisData,
+    cdata: ClusterData,
+    p: jax.Array,
+    rho: Optional[jax.Array] = None,
+    Bpoly: Optional[jax.Array] = None,
+    Binv: Optional[jax.Array] = None,
+) -> np.ndarray:
+    """Influence eigenvalues in place of residuals: (F, 4, rows) complex
+    (flat layout; every channel carries the same values, as the
+    reference computes F==1 and replicates).
+
+    p: (M, nchunk_max, 8N) solved parameters; rho/Bpoly/Binv: optional
+    consensus info (per-cluster rho (M,), basis row (Npoly,), inverses
+    (M, Npoly, Npoly)) for the constraint curvature.
+    """
+    M = cdata.coh.shape[0]
+    N = data.nstations
+    Bt = data.nbase
+    T = data.tilesz
+    F = data.nchan
+    rows = Bt * T
+
+    # residual at the solution, channel 0 (F==1 in the reference)
+    res_flat = (data.vis - predict_full_model(p, cdata, data)) * data.mask[
+        ..., None, :
+    ]
+    # per-row 2x2 mat views, channel 0
+    def mat22(flat_c):  # (4, rows) -> (rows, 2, 2)
+        return jnp.moveaxis(flat_c, -1, 0).reshape(rows, 2, 2)
+
+    Rm = mat22(res_flat[0])
+    maskr = data.mask[0]  # (rows,)
+
+    dR = jnp.zeros((Bt, Bt, 2, 2), jnp.complex64)
+    ones2 = jnp.full((2, 2), 1.0 + 1.0j, jnp.complex64)
+
+    for k in range(M):
+        Cm = mat22(cdata.coh[k, 0]) * maskr[:, None, None]
+        jones = params_to_jones(p[k])  # (nchunk, N, 2, 2)
+        Jp = jones[cdata.chunk_map[k], data.ant_p]
+        Jq = jones[cdata.chunk_map[k], data.ant_q]
+        H = _cluster_hessian(
+            Cm.astype(jnp.complex64), Rm.astype(jnp.complex64),
+            Jp.astype(jnp.complex64), Jq.astype(jnp.complex64),
+            data.ant_p, data.ant_q, N,
+        )
+        extra = 0.0
+        if rho is not None and Bpoly is not None and Binv is not None:
+            extra = consensus_hessian_addition(rho[k], Bpoly, Binv[k])
+        H = _condition_diag(H, extra)
+
+        # AdV: (4N, Bt) gradient perturbations [kernel_d_solutions]
+        herm = lambda m: jnp.conj(jnp.swapaxes(m, -1, -2))
+        JqCH = (Jq @ herm(Cm)).reshape(T, Bt, 2, 2).sum(0)  # (Bt, 2, 2)
+        blockp = (ones2[None] @ JqCH.astype(jnp.complex64))  # (Bt, 2, 2)
+        # scatter station-p row blocks: vec index (c*2N + 2s + r)
+        AdV = jnp.zeros((2, N, 2, Bt), jnp.complex64)  # (c, sta, r, col)
+        bl_idx = jnp.arange(Bt)
+        p_bl = data.ant_p[:Bt]  # station map constant across timeslots
+        AdV = AdV.at[:, p_bl, :, bl_idx].add(
+            jnp.transpose(blockp, (0, 2, 1))  # (Bt, c, r)
+        )
+        AdV = AdV.reshape(4 * N, Bt)
+
+        U, *_ = jnp.linalg.lstsq(H, AdV)  # (4N, Bt) gain sensitivities
+        Up = U.reshape(2, N, 2, Bt)  # (c, sta, r, col)
+
+        # dR accumulation [kernel_d_residuals]: only the p (sta1) block
+        Asum = (-(Cm @ herm(Jq))).reshape(T, Bt, 2, 2).sum(0)  # (Bt, 2, 2)
+        # contribution[b_row, col, r, c] = sum_k Up[c? ...]
+        Upb = jnp.transpose(Up[:, p_bl], (1, 2, 0, 3))  # (Bt, r, c, col)
+        contrib = jnp.einsum(
+            "brkl,bkc->blrc", Upb, Asum.astype(jnp.complex64)
+        )  # (Bt, col, 2, 2)
+        dR = dR + contrib
+
+    # eigenvalues per correlation, vec order [00, 10, 01, 11]
+    dR_np = np.asarray(dR)
+    out = np.zeros((rows, 8), np.float64)
+    for ci, (r, c) in enumerate(((0, 0), (1, 0), (0, 1), (1, 1))):
+        lam = np.linalg.eigvals(dR_np[:, :, r, c])  # (Bt,)
+        out[:, 2 * ci] = np.tile(lam.real, T)
+        out[:, 2 * ci + 1] = np.tile(lam.imag, T)
+    # -> flat (F, 4, rows) complex, replicated over channels
+    cplx = out[:, 0::2] + 1j * out[:, 1::2]  # (rows, 4) in vec order
+    # vec order [00,10,01,11] -> component order [00,01,10,11]
+    cplx = cplx[:, [0, 2, 1, 3]]
+    flat = np.broadcast_to(np.moveaxis(cplx, 0, -1)[None], (F, 4, rows))
+    return np.ascontiguousarray(flat)

@@ -17,7 +17,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-from sagecal_tpu.core.types import VisData, herm, params_to_jones
+from sagecal_tpu.core.types import VisData, corrupt_flat_2sided, params_to_jones
 from sagecal_tpu.parallel.manifold import extract_phases
 from sagecal_tpu.solvers.sage import ClusterData, predict_full_model
 
@@ -57,10 +57,8 @@ def correction_jones(
 def apply_correction(vis, pinv, ant_p, ant_q, chunk_map):
     """x <- Ginv_p x Ginv_q^H per row (residual.c:880-930).
 
-    vis: (rows, F, 2, 2); pinv: (nchunk, N, 2, 2); indices (rows,)."""
-    g1 = pinv[chunk_map, ant_p]  # (rows, 2, 2)
-    g2 = pinv[chunk_map, ant_q]
-    return g1[:, None] @ vis @ herm(g2)[:, None]
+    vis: flat (F, 4, rows); pinv: (nchunk, N, 2, 2); indices (rows,)."""
+    return corrupt_flat_2sided(pinv, pinv, vis, ant_p, ant_q, chunk_map)
 
 
 def calculate_residuals(
@@ -114,9 +112,9 @@ def simulate_visibilities(
         jnp.real(cdata.coh).dtype,
     )
     if p is None:
-        model = jnp.einsum("k,krfij->rfij", keep.astype(cdata.coh.dtype), cdata.coh)
+        model = jnp.einsum("k,kfcr->fcr", keep.astype(cdata.coh.dtype), cdata.coh)
     else:
-        masked = cdata._replace(coh=cdata.coh * keep[:, None, None, None, None])
+        masked = cdata._replace(coh=cdata.coh * keep[:, None, None, None])
         model = predict_full_model(p, masked, data)
     if ccid_index is not None and p is not None:
         pinv = correction_jones(p[ccid_index], rho, phase_only)
@@ -132,7 +130,8 @@ def simulate_visibilities(
 
 def residual_norm(res: jax.Array, mask: jax.Array) -> jax.Array:
     """||res||/n_real, the per-tile print (fullbatch_mode.cpp:636-643).
-    Delegates to the solver's bookkeeping so the two stay identical."""
+    Delegates to the solver's bookkeeping so the two stay identical.
+    res: flat (F, 4, rows); mask: (F, rows)."""
     from sagecal_tpu.solvers.sage import _res_norm
 
-    return _res_norm(res, mask, res.shape[0] * res.shape[1] * 8)
+    return _res_norm(res, mask, res.shape[-3] * res.shape[-1] * 8)

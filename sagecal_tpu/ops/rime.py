@@ -269,7 +269,8 @@ def predict_coherencies(
     tdelta: float = 0.0,
     dec0: float = 0.0,
 ) -> jax.Array:
-    """Sum of source coherencies on every baseline row: (rows, F, 2, 2) complex.
+    """Sum of source coherencies on every baseline row: (F, 4, rows) complex
+    (canonical flat layout, components [XX, XY, YX, YY] on axis -2).
 
     The jitted, differentiable equivalent of ``precalculate_coherencies``
     (predict.c:503) for one cluster — and of ``predict_visibilities``'s
@@ -376,13 +377,14 @@ def _predict_coherencies(
         C = jnp.stack(
             [I + Q, U + 1j * V, U - 1j * V, I - Q], axis=-1
         ).astype(cdtype)  # (chunk, F, 4)
-        # contraction over sources: batched matmul (F, rows, chunk)@(F, chunk, 4)
-        contrib = jnp.einsum("frs,sfc->rfc", phs, C)
+        # contraction over sources: batched matmul (F, chunk, 4)^T @ (F, rows, chunk)
+        # -> canonical (F, 4, rows) flat layout
+        contrib = jnp.einsum("frs,sfc->fcr", phs, C)
         return acc + contrib, None
 
-    init = jnp.zeros((rows, F, 4), cdtype)
+    init = jnp.zeros((F, 4, rows), cdtype)
     acc, _ = jax.lax.scan(one_chunk, init, chunked)
-    return acc.reshape(rows, F, 2, 2)
+    return acc
 
 
 def predict_model(
@@ -394,10 +396,10 @@ def predict_model(
 
     ``clusters``: list of SourceBatch.  ``jones``: optional (nclus, N, 2, 2).
     ``shapelet_tables``: optional per-cluster ShapeletTable (or None).
-    Equivalent of ``predict_visibilities_multifreq[_withsol]``
-    (residual.c:1257,1621).
+    Returns canonical flat (F, 4, rows).  Equivalent of
+    ``predict_visibilities_multifreq[_withsol]`` (residual.c:1257,1621).
     """
-    from sagecal_tpu.core.types import apply_gains
+    from sagecal_tpu.core.types import corrupt_flat
 
     if not clusters:
         raise ValueError("predict_model: empty cluster list")
@@ -408,7 +410,7 @@ def predict_model(
             u, v, w, freqs, src, fdelta, source_chunk, shapelets=tab
         )
         if jones is not None:
-            coh = apply_gains(jones[ci], coh, ant_p, ant_q)
+            coh = corrupt_flat(jones[ci], coh, ant_p, ant_q)
         total = coh if total is None else total + coh
     return total
 

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from sagecal_tpu.core.types import VisData
-from sagecal_tpu.solvers.lm import LMConfig, _residual_rows, lm_solve
+from sagecal_tpu.solvers.lm import LMConfig, _residual_flat, lm_solve
 from sagecal_tpu.solvers.robust import update_w_and_nu
 from sagecal_tpu.solvers.sage import (
     SM_LM_LBFGS,
@@ -76,7 +76,7 @@ def admm_sagefit(
         E-step); robust RTR/NSD modes run their own nu EM instead.
       solver_mode: SM_* dispatch (see module docstring).
     """
-    rows, F = data.vis.shape[0], data.vis.shape[1]
+    F, rows = data.vis.shape[-3], data.vis.shape[-1]
     nreal = rows * F * 8
 
     full0 = predict_full_model(p0, cdata, data)
@@ -86,7 +86,7 @@ def admm_sagefit(
     use_nsd = solver_mode == SM_NSD_RLBFGS
     robust = solver_mode in _ROBUST_MODES
     mask8 = (
-        jnp.repeat(data.mask, 8, axis=-1)
+        data.mask[..., None, :]  # broadcasts over the (F, 8, rows) residual
         if (robust_nu is not None and not (use_rtr or use_nsd))
         else None
     )
@@ -130,7 +130,7 @@ def admm_sagefit(
                 )
             return res.p, None
         if robust_nu is not None:
-            ed = _residual_rows(
+            ed = _residual_flat(
                 p_k, coh_k, xeff, data.mask, data.ant_p, data.ant_q, cmap_k, None
             )
             sqrt_w, _ = update_w_and_nu(

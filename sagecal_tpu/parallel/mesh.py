@@ -68,6 +68,29 @@ class AdmmResult(NamedTuple):
     rho: jax.Array  # (Nf, M) final penalties
     dual_res: jax.Array  # (nadmm,) dual residual trace
     primal_res: jax.Array  # (nadmm,) mean primal residual ||J - BZ||
+    Zspat: Optional[jax.Array] = None  # (2*Npoly*N*nchunk?, 2G) spatial model
+    spat_res: Optional[jax.Array] = None  # (nadmm,) ||Z - Zbar|| trace
+
+
+class SpatialConfig(NamedTuple):
+    """Spatial-regularization coupling for the mesh ADMM loop
+    (the master's Zbar/Zspat/X machinery, sagecal_master.cpp:887-930).
+
+    Phi: (Meff, 2G, 2) per-effective-cluster spatial basis blocks
+      (:func:`sagecal_tpu.parallel.spatial.build_spatial_basis`);
+    Phikk: (2G, 2G) = sum_k Phi_k Phi_k^H + lambda I;
+    alpha: (M,) per-cluster spatial coupling strengths (the -G file's
+      alpha column);
+    mu: L1 strength; cadence: run the FISTA update every this many ADMM
+    iterations (-O admm_cadence); fista_maxiter: inner FISTA steps.
+    """
+
+    Phi: jax.Array
+    Phikk: jax.Array
+    alpha: jax.Array
+    mu: float = 1e-3
+    cadence: int = 2
+    fista_maxiter: int = 30
 
 
 def _flat(x):
@@ -78,16 +101,21 @@ def _unflat(x, nchunk, n8):
     return x.reshape(x.shape[:-1] + (nchunk, n8))
 
 
-def _zstep_grouped(Yhat_flat, rho, B_g, axis_name, federated_alpha=None):
+def _zstep_grouped(Yhat_flat, rho, B_g, axis_name, federated_alpha=None,
+                   z_extra=None):
     """psum z accumulation + replicated Bii + Z update.
 
     Yhat_flat (G, M, K); rho (G, M); B_g (G, Npoly) — all local
     sub-bands contribute (vmapped accumulate, summed locally, then
-    psum'd across the mesh)."""
+    psum'd across the mesh).  ``z_extra``: optional replicated
+    (M, Npoly, K) addition to the accumulated z (the spatial-reg
+    ``alpha Zbar - X`` term, sagecal_master.cpp:855-872)."""
     z_local = jnp.sum(
         jax.vmap(consensus.accumulate_z_term)(B_g, Yhat_flat), axis=0
     )
     z = jax.lax.psum(z_local, axis_name)
+    if z_extra is not None:
+        z = z + z_extra
     P_term = jnp.einsum("gm,gp,gq->mpq", rho, B_g, B_g)
     P_sum = jax.lax.psum(P_term, axis_name)
     if federated_alpha is not None:
@@ -97,6 +125,25 @@ def _zstep_grouped(Yhat_flat, rho, B_g, axis_name, federated_alpha=None):
         )[None]
     Bii = jnp.linalg.pinv(P_sum)
     return consensus.update_global_z(z, Bii)
+
+
+def _zbar_blocks_of_z(Z, M, Npoly, nchunk, n8):
+    """Param-space Z (M, Npoly, nchunk*n8) -> complex spatial blocks
+    (M*nchunk, 2*N*Npoly, 2) — the master's Z->Zbar reshaping
+    (sagecal_master.cpp:889-906); hybrid chunks become separate
+    effective clusters as in the reference."""
+    N = n8 // 8
+    J = params_to_jones(Z.reshape(M, Npoly, nchunk, n8))
+    X = jnp.transpose(J, (0, 2, 1, 3, 4, 5))  # (M, nchunk, Npoly, N, 2, 2)
+    return X.reshape(M * nchunk, Npoly * N * 2, 2)
+
+
+def _z_of_zbar_blocks(Xb, M, Npoly, nchunk, n8):
+    """Inverse of :func:`_zbar_blocks_of_z`."""
+    N = n8 // 8
+    J = Xb.reshape(M, nchunk, Npoly, N, 2, 2)
+    J = jnp.transpose(J, (0, 2, 1, 3, 4, 5))  # (M, Npoly, nchunk, N, 2, 2)
+    return jones_to_params(J).reshape(M, Npoly, nchunk * n8)
 
 
 def make_admm_mesh_fn(
@@ -111,6 +158,7 @@ def make_admm_mesh_fn(
     rho_upper: float = 1e3,
     solver_mode: int = SM_LM_LBFGS,
     robust_nu: Optional[float] = None,
+    spatial: Optional[SpatialConfig] = None,
 ):
     """Build the jitted mesh-wide ADMM calibration function.
 
@@ -126,6 +174,16 @@ def make_admm_mesh_fn(
     ``solver_mode``/``robust_nu`` select the local x-step solver the way
     ``sagefit_visibilities_admm`` dispatches (see
     :func:`sagecal_tpu.parallel.admm.admm_sagefit`).
+
+    ``spatial``: optional :class:`SpatialConfig` — couples the consensus
+    Z to a smooth spatial model across directions, INSIDE the ADMM
+    iteration at the reference's cadence (sagecal_master.cpp:855-930):
+    the z-step gains ``+ alpha Zbar - X`` with a federated ``+alpha I``
+    in the Bii inverse, and every ``cadence`` iterations the spatial
+    model Zspat is re-fit by FISTA, Zbar <- Zspat Phi, and the Lagrange
+    multiplier X steps by ``alpha (Z - Zbar)``.  All spatial state is
+    replicated across the mesh (it is master-side math in the
+    reference — tiny compared to the sharded x-steps).
     """
 
     def _fit(data, cdata, p, Y, BZ, rho_m, emiter):
@@ -164,6 +222,39 @@ def make_admm_mesh_fn(
         Yhat = rho[:, :, None, None] * p  # Y=0 so Yhat = rho*J
         Z = _zstep_grouped(_flat(Yhat), rho, B_g, axis_name)
 
+        use_spatial = spatial is not None
+        if use_spatial:
+            M_ = p0.shape[1]
+            K = nchunk_max * n8
+            Zbar_flat0 = jnp.zeros((M_, B_g.shape[-1], K), p0.dtype)
+            Xsp0 = jnp.zeros_like(Zbar_flat0)
+            D = 2 * (n8 // 8) * B_g.shape[-1]
+            twoG = spatial.Phikk.shape[0]
+            Zspat0 = jnp.zeros((D, twoG), jnp.complex64 if p0.dtype == jnp.float32
+                               else jnp.complex128)
+            alpha_sp = spatial.alpha.astype(p0.dtype)
+
+            def spatial_update(Z, Xsp):
+                """FISTA re-fit + Zbar/X updates (cadenced)."""
+                from sagecal_tpu.parallel.spatial import (
+                    spatial_model_apply, update_spatialreg_fista,
+                )
+
+                Zbar_c = _zbar_blocks_of_z(Z, M_, B_g.shape[-1], nchunk_max, n8)
+                Zs = update_spatialreg_fista(
+                    Zbar_c, spatial.Phikk.astype(Zspat0.dtype),
+                    spatial.Phi.astype(Zspat0.dtype),
+                    spatial.mu, maxiter=spatial.fista_maxiter,
+                )
+                Zbar_new_c = spatial_model_apply(Zs, spatial.Phi.astype(Zs.dtype))
+                Zbar_new = _z_of_zbar_blocks(
+                    Zbar_new_c, M_, B_g.shape[-1], nchunk_max, n8
+                ).astype(p0.dtype)
+                Zerr = Z - Zbar_new
+                Xsp_new = Xsp + alpha_sp[:, None, None] * Zerr
+                sres = jnp.linalg.norm(Zerr.ravel()) / Zerr.size
+                return Zbar_new, Xsp_new, Zs, sres
+
         def bz_of(Z_, g):
             return _unflat(
                 consensus.bz_for_freq(Z_, B_g[g]), nchunk_max, n8
@@ -174,7 +265,7 @@ def make_admm_mesh_fn(
 
         # ---- admm > 0: rotate over local slots -------------------------
         def one_iter(carry, it):
-            p, Y, Z, rho, Yhat_all, Yhat_prev, p_prev = carry
+            p, Y, Z, rho, Yhat_all, Yhat_prev, p_prev, spstate = carry
             g = (it - 1) % G  # active local slot (Scurrent rotation)
             d_g = jax.tree_util.tree_map(
                 lambda x: jax.lax.dynamic_index_in_dim(x, g, keepdims=False),
@@ -193,7 +284,24 @@ def make_admm_mesh_fn(
             Yhat_g = Y_g + rho_g[:, None, None] * p1_g
             p1 = p.at[g].set(p1_g)
             Yhat_all1 = Yhat_all.at[g].set(Yhat_g)
-            Z1 = _zstep_grouped(_flat(Yhat_all1), rho, B_g, axis_name)
+            if use_spatial:
+                Zbar_flat, Xsp, Zs_c, _ = spstate
+                z_extra = alpha_sp[:, None, None] * Zbar_flat - Xsp
+                Z1 = _zstep_grouped(
+                    _flat(Yhat_all1), rho, B_g, axis_name,
+                    federated_alpha=alpha_sp, z_extra=z_extra,
+                )
+                # cadenced spatial re-fit (sagecal_master.cpp:887-930)
+                do_sp = (it % spatial.cadence) == 0
+                spstate1 = jax.lax.cond(
+                    do_sp,
+                    lambda args: spatial_update(args[0], args[1][1]),
+                    lambda args: args[1],
+                    (Z1, spstate),
+                )
+            else:
+                Z1 = _zstep_grouped(_flat(Yhat_all1), rho, B_g, axis_name)
+                spstate1 = spstate
             BZ1_g = bz_of(Z1, g)
             Y1 = Y.at[g].set(Yhat_g - rho_g[:, None, None] * BZ1_g)
             dres = consensus.admm_dual_residual(Z1, Z)
@@ -217,17 +325,25 @@ def make_admm_mesh_fn(
                 rho1 = rho
             Yhat_prev1 = Yhat_prev.at[g].set(Yhat_g)
             p_prev1 = p_prev.at[g].set(p1_g)
-            return (p1, Y1, Z1, rho1, Yhat_all1, Yhat_prev1, p_prev1), (
-                dres, pres,
+            sres_out = spstate1[3] if use_spatial else jnp.zeros((), p0.dtype)
+            return (p1, Y1, Z1, rho1, Yhat_all1, Yhat_prev1, p_prev1, spstate1), (
+                dres, pres, sres_out,
             )
 
-        init = (p, Y, Z, rho, Yhat, Yhat, p)
-        (p, Y, Z, rho, _, _, _), (dres, pres) = jax.lax.scan(
+        spstate0 = (
+            (Zbar_flat0, Xsp0, Zspat0, jnp.zeros((), p0.dtype))
+            if use_spatial
+            else jnp.zeros((), p0.dtype)
+        )
+        init = (p, Y, Z, rho, Yhat, Yhat, p, spstate0)
+        (p, Y, Z, rho, _, _, _, spstate), (dres, pres, sres) = jax.lax.scan(
             one_iter, init, jnp.arange(1, nadmm)
         )
         dres = jnp.concatenate([jnp.zeros((1,), dres.dtype), dres])
         pres = jnp.concatenate([jnp.zeros((1,), pres.dtype), pres])
-        return p, Y, Z, rho, dres, pres
+        sres = jnp.concatenate([jnp.zeros((1,), sres.dtype), sres])
+        Zspat_out = spstate[2] if use_spatial else jnp.zeros((1, 1), jnp.complex64)
+        return p, Y, Z, rho, dres, pres, Zspat_out, sres
 
     fspec = P(axis_name)
     rspec = P()
@@ -246,11 +362,16 @@ def make_admm_mesh_fn(
             local_loop,
             mesh=mesh,
             in_specs=(fspec, fspec, fspec, fspec, fspec),
-            out_specs=(fspec, fspec, rspec, fspec, rspec, rspec),
+            out_specs=(fspec, fspec, rspec, fspec, rspec, rspec, rspec, rspec),
             check_vma=False,
         )
-        p, Y, Z, rho_f, dres, pres = sm(data_stack, cdata_stack, p0, rho, B)
-        return AdmmResult(p=p, Y=Y, Z=Z, rho=rho_f, dual_res=dres, primal_res=pres)
+        p, Y, Z, rho_f, dres, pres, Zspat, sres = sm(
+            data_stack, cdata_stack, p0, rho, B
+        )
+        return AdmmResult(
+            p=p, Y=Y, Z=Z, rho=rho_f, dual_res=dres, primal_res=pres,
+            Zspat=Zspat, spat_res=sres,
+        )
 
     return fn
 

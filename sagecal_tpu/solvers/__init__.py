@@ -1,1 +1,2 @@
-from sagecal_tpu.solvers import lbfgs, lm, robust  # noqa: F401
+from sagecal_tpu.solvers import lbfgs, lbfgsb, lm, robust  # noqa: F401
+from sagecal_tpu.solvers.lbfgsb import LBFGSBResult, lbfgsb_fit  # noqa: F401

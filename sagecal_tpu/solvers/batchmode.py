@@ -30,7 +30,7 @@ def _data_cost(pflat, data: VisData, cdata: ClusterData, shape, robust_nu):
     M, nchunk, n8 = shape
     pa = pflat.reshape(M, nchunk, n8)
     model = predict_full_model(pa, cdata, data)
-    diff = (data.vis - model) * data.mask[..., None, None]
+    diff = (data.vis - model) * data.mask[..., None, :]
     e2 = jnp.real(diff) ** 2 + jnp.imag(diff) ** 2
     if robust_nu is not None:
         return jnp.sum(jnp.log1p(e2 / robust_nu))

@@ -29,7 +29,13 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from sagecal_tpu.core.types import params_to_jones
+from sagecal_tpu.core.types import corrupt_flat, params_to_jones, reals_of_flat
+
+# Row-block size for the Jacobian-assembly scan: bounds the per-block
+# (RB, F*8, 8) Jacobian intermediates so assembly memory is O(block), not
+# O(rows) — at the 62-stn/100-cluster/60-ts shape the unblocked
+# intermediates would be ~1 GB each after TPU tile padding.
+_ROW_BLOCK = 8192
 
 
 @struct.dataclass
@@ -48,18 +54,15 @@ class LMResult(NamedTuple):
     iterations: jax.Array
 
 
-def _residual_rows(p_all, coh, vis, mask, ant_p, ant_q, chunk_map, sqrt_w):
-    """Real residual rows (rows, F*8): vec(vis - J_p C J_q^H) * mask * sqrt_w.
+def _residual_flat(p_all, coh, vis, mask, ant_p, ant_q, chunk_map, sqrt_w):
+    """Real residual elements (F, 8, rows): reals of (vis - J_p C J_q^H)
+    * mask * sqrt_w, in the reference's 8-real ordering (Dirac.h:1617).
 
-    p_all: (nchunk, 8N) real params.
+    p_all: (nchunk, 8N) real params; vis/coh flat (F, 4, rows).
     """
-    jones = params_to_jones(p_all)  # (nchunk, N, 2, 2)
-    jp = jones[chunk_map, ant_p]  # (rows, 2, 2)
-    jq = jones[chunk_map, ant_q]
-    model = jp[:, None] @ coh @ jnp.conj(jnp.swapaxes(jq, -1, -2))[:, None]
-    diff = (vis - model) * mask[..., None, None]
-    r = jnp.stack([jnp.real(diff), jnp.imag(diff)], axis=-1)  # (rows,F,2,2,2)
-    r = r.reshape(r.shape[0], -1)  # (rows, F*8)
+    model = corrupt_flat(params_to_jones(p_all), coh, ant_p, ant_q, chunk_map)
+    diff = (vis - model) * mask[..., None, :]
+    r = reals_of_flat(diff)  # (F, 8, rows)
     if sqrt_w is not None:
         r = r * sqrt_w
     return r
@@ -68,7 +71,9 @@ def _residual_rows(p_all, coh, vis, mask, ant_p, ant_q, chunk_map, sqrt_w):
 def _row_model(pp, qq, C, mask_row, sqrt_w_row):
     """Model for ONE row as a function of its two stations' 16 params.
 
-    pp, qq: (8,) real params; C: (F,2,2) complex. Returns (F*8,) reals.
+    pp, qq: (8,) real params; C: (F,2,2) complex. Returns (F*8,) reals
+    ordered (f, i, j, re/im) — identical to one row of
+    :func:`_residual_flat`'s (F, 8) elements.
     """
     Jp = params_to_jones(pp)[0]  # (2,2)
     Jq = params_to_jones(qq)[0]
@@ -80,54 +85,101 @@ def _row_model(pp, qq, C, mask_row, sqrt_w_row):
     return r
 
 
-def _assemble_normal_eq(p_all, coh, vis, mask, ant_p, ant_q, chunk_map, nchunk, sqrt_w):
-    """One fused pass over rows -> (JTJ (nchunk,8N,8N), JTe (nchunk,8N), cost (nchunk,)).
+def _pad_rows(x, padr, axis=-1):
+    if padr == 0:
+        return x
+    cfg = [(0, 0)] * x.ndim
+    cfg[axis % x.ndim] = (0, padr)
+    return jnp.pad(x, cfg)
 
-    The sign convention: residual e = vis - model, Jacobian taken of the
+
+def _assemble_normal_eq(p_all, coh, vis, mask, ant_p, ant_q, chunk_map, nchunk, sqrt_w):
+    """Row-blocked pass -> (JTJ (nchunk,8N,8N), JTe (nchunk,8N), cost (nchunk,)).
+
+    Sign convention: residual e = vis - model, Jacobian taken of the
     *model*, so the gradient of 0.5||e||^2 is -J^T e; we return JTe = J^T e
     (the LM step solves (JTJ + mu I) dp = JTe).
+
+    Each residual row depends only on its two stations' 16 parameters, so
+    J^T J is assembled from per-row 8x8 blocks scattered into an
+    (nchunk, N, N, 8, 8) grid — the TPU answer to the reference's full
+    (8*Nbase*tilesz x 8N) Jacobian materialization (clmfit.c).  Rows are
+    processed in blocks of ``_ROW_BLOCK`` under ``lax.scan`` so the
+    per-row mat-form intermediates stay bounded at any tile size.
     """
     N = p_all.shape[-1] // 8
+    dtype = p_all.dtype
+    rows = ant_p.shape[0]
+    F = vis.shape[-3]
 
-    e = _residual_rows(p_all, coh, vis, mask, ant_p, ant_q, chunk_map, sqrt_w)
-    rows = e.shape[0]
+    e = _residual_flat(p_all, coh, vis, mask, ant_p, ant_q, chunk_map, sqrt_w)
+    cost = jnp.zeros((nchunk,), dtype).at[chunk_map].add(jnp.sum(e * e, axis=(0, 1)))
 
     pblk = p_all.reshape(nchunk, N, 8)
-    pp = pblk[chunk_map, ant_p]  # (rows, 8)
-    qq = pblk[chunk_map, ant_q]
+
+    nblk = -(-rows // _ROW_BLOCK)
+    RB = -(-rows // nblk)
+    padr = nblk * RB - rows
+    coh_b = jnp.moveaxis(
+        _pad_rows(coh, padr).reshape(F, 4, nblk, RB), 2, 0
+    )  # (nblk, F, 4, RB)
+    mask_b = jnp.moveaxis(_pad_rows(mask, padr).reshape(F, nblk, RB), 1, 0)
+    e_b = jnp.moveaxis(_pad_rows(e, padr).reshape(F, 8, nblk, RB), 2, 0)
+    ap_b = _pad_rows(ant_p, padr).reshape(nblk, RB)
+    aq_b = _pad_rows(ant_q, padr).reshape(nblk, RB)
+    cm_b = _pad_rows(chunk_map, padr).reshape(nblk, RB)
+    with_w = sqrt_w is not None
+    if with_w:
+        sw_full = jnp.broadcast_to(sqrt_w, e.shape)
+        sw_b = jnp.moveaxis(_pad_rows(sw_full, padr).reshape(F, 8, nblk, RB), 2, 0)
+    else:
+        sw_b = jnp.zeros((nblk, 1, 1, 1), dtype)  # unused placeholder
 
     jac_fn = jax.vmap(
         jax.jacfwd(_row_model, argnums=(0, 1)),
-        in_axes=(0, 0, 0, 0, 0 if sqrt_w is not None else None),
+        in_axes=(0, 0, 0, 0, 0 if with_w else None),
     )
-    Jp, Jq = jac_fn(pp, qq, coh, mask, sqrt_w)  # (rows, F8, 8) each
 
-    # per-row blocks of J^T J and J^T e
-    App = jnp.einsum("rki,rkj->rij", Jp, Jp)
-    Apq = jnp.einsum("rki,rkj->rij", Jp, Jq)
-    Aqq = jnp.einsum("rki,rkj->rij", Jq, Jq)
-    gp = jnp.einsum("rki,rk->ri", Jp, e)
-    gq = jnp.einsum("rki,rk->ri", Jq, e)
+    def block(carry, xs):
+        JTJ, JTe = carry
+        coh_k, mask_k, e_k, ap, aq, cm, sw_k = xs
+        C = jnp.moveaxis(coh_k, -1, 0).reshape(RB, F, 2, 2)
+        mrow = jnp.moveaxis(mask_k, -1, 0)  # (RB, F)
+        erow = jnp.moveaxis(e_k, -1, 0).reshape(RB, F * 8)
+        swrow = (
+            jnp.moveaxis(sw_k, -1, 0).reshape(RB, F * 8) if with_w else None
+        )
+        pp = pblk[cm, ap]  # (RB, 8)
+        qq = pblk[cm, aq]
+        Jp, Jq = jac_fn(pp, qq, C, mrow, swrow)  # (RB, F8, 8) each
+        App = jnp.einsum("rki,rkj->rij", Jp, Jp)
+        Apq = jnp.einsum("rki,rkj->rij", Jp, Jq)
+        Aqq = jnp.einsum("rki,rkj->rij", Jq, Jq)
+        gp = jnp.einsum("rki,rk->ri", Jp, erow)
+        gq = jnp.einsum("rki,rk->ri", Jq, erow)
+        JTJ = JTJ.at[cm, ap, ap].add(App)
+        JTJ = JTJ.at[cm, ap, aq].add(Apq)
+        JTJ = JTJ.at[cm, aq, ap].add(jnp.swapaxes(Apq, -1, -2))
+        JTJ = JTJ.at[cm, aq, aq].add(Aqq)
+        JTe = JTe.at[cm, ap].add(gp)
+        JTe = JTe.at[cm, aq].add(gq)
+        return (JTJ, JTe), None
 
-    JTJ = jnp.zeros((nchunk, N, N, 8, 8), p_all.dtype)
-    JTJ = JTJ.at[chunk_map, ant_p, ant_p].add(App)
-    JTJ = JTJ.at[chunk_map, ant_p, ant_q].add(Apq)
-    JTJ = JTJ.at[chunk_map, ant_q, ant_p].add(jnp.swapaxes(Apq, -1, -2))
-    JTJ = JTJ.at[chunk_map, ant_q, ant_q].add(Aqq)
+    JTJ0 = jnp.zeros((nchunk, N, N, 8, 8), dtype)
+    JTe0 = jnp.zeros((nchunk, N, 8), dtype)
+    (JTJ, JTe), _ = jax.lax.scan(
+        block, (JTJ0, JTe0), (coh_b, mask_b, e_b, ap_b, aq_b, cm_b, sw_b)
+    )
     JTJ = JTJ.transpose(0, 1, 3, 2, 4).reshape(nchunk, 8 * N, 8 * N)
-
-    JTe = jnp.zeros((nchunk, N, 8), p_all.dtype)
-    JTe = JTe.at[chunk_map, ant_p].add(gp)
-    JTe = JTe.at[chunk_map, ant_q].add(gq)
     JTe = JTe.reshape(nchunk, 8 * N)
-
-    cost = jnp.zeros((nchunk,), p_all.dtype).at[chunk_map].add(jnp.sum(e * e, axis=-1))
     return JTJ, JTe, cost
 
 
 def _cost_only(p_all, coh, vis, mask, ant_p, ant_q, chunk_map, nchunk, sqrt_w):
-    e = _residual_rows(p_all, coh, vis, mask, ant_p, ant_q, chunk_map, sqrt_w)
-    return jnp.zeros((nchunk,), p_all.dtype).at[chunk_map].add(jnp.sum(e * e, axis=-1))
+    e = _residual_flat(p_all, coh, vis, mask, ant_p, ant_q, chunk_map, sqrt_w)
+    return jnp.zeros((nchunk,), p_all.dtype).at[chunk_map].add(
+        jnp.sum(e * e, axis=(0, 1))
+    )
 
 
 def _solve_spd(A, b):
@@ -176,13 +228,13 @@ def lm_solve(
     ``JTJ += rho I`` and ``JTe -= y + rho (p - bz)``.
 
     Args:
-      vis: (rows, F, 2, 2) complex effective data for this cluster.
-      coh: (rows, F, 2, 2) complex precomputed cluster coherencies.
-      mask: (rows, F) flag mask.
+      vis: (F, 4, rows) complex effective data for this cluster (flat).
+      coh: (F, 4, rows) complex precomputed cluster coherencies (flat).
+      mask: (F, rows) flag mask.
       ant_p/ant_q: (rows,) station indices.
       chunk_map: (rows,) int32 hybrid-chunk index of each row.
       p0: (nchunk, 8N) initial parameters.
-      sqrt_weights: optional (rows, F*8) robust sqrt-weights.
+      sqrt_weights: optional (F, 8, rows)-broadcastable robust sqrt-weights.
     Returns LMResult with per-chunk solutions.
     """
     nchunk = p0.shape[0]
@@ -283,7 +335,7 @@ def os_lm_solve(
     """
     if key is None:
         key = jax.random.PRNGKey(0)
-    rows = vis.shape[0]
+    rows = vis.shape[-1]
     perm = jax.random.permutation(key, rows)
     subset_of_row = jnp.zeros((rows,), jnp.int32).at[perm].set(
         jnp.arange(rows, dtype=jnp.int32) % nsubsets
@@ -296,7 +348,7 @@ def os_lm_solve(
     cost0 = None
     res = None
     for s in range(nsubsets):
-        m_s = mask * (subset_of_row == s)[:, None].astype(mask.dtype)
+        m_s = mask * (subset_of_row == s)[None, :].astype(mask.dtype)
         res = lm_solve(
             vis, coh, m_s, ant_p, ant_q, chunk_map, p, sub_cfg, sqrt_weights
         )

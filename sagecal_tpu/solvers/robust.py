@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.special import digamma
 
-from sagecal_tpu.solvers.lm import LMConfig, LMResult, _residual_rows, lm_solve
+from sagecal_tpu.solvers.lm import LMConfig, LMResult, _residual_flat, lm_solve
 
 
 def update_w_and_nu(
@@ -42,9 +42,10 @@ def update_w_and_nu(
     w = (nu0 + 1.0) / (nu0 + ed * ed)
     q = w - jnp.log(w)  # per-element, positive
     if mask is not None:
-        msum = jnp.maximum(jnp.sum(mask), 1.0)
-        sumq = jnp.sum(jnp.abs(q) * mask) / msum
-        w = jnp.where(mask > 0, w, 1.0)
+        mfull = jnp.broadcast_to(mask, w.shape)
+        msum = jnp.maximum(jnp.sum(mfull), 1.0)
+        sumq = jnp.sum(jnp.abs(q) * mfull) / msum
+        w = jnp.where(mfull > 0, w, 1.0)
     else:
         sumq = jnp.mean(jnp.abs(q))
     deltanu = (nuhigh - nulow) / Nd
@@ -92,14 +93,14 @@ def robust_lm_solve(
 
     Returns (LMResult, nu).
     """
-    mask8 = jnp.repeat(mask, 8, axis=-1)  # (rows, F*8)
+    mask8 = mask[..., None, :]  # broadcasts over the (F, 8, rows) residual
 
     def em_step(carry, _):
         p, nu, sqrt_w = carry
         res = lm_solve(
             vis, coh, mask, ant_p, ant_q, chunk_map, p, config, sqrt_weights=sqrt_w
         )
-        ed = _residual_rows(res.p, coh, vis, mask, ant_p, ant_q, chunk_map, None)
+        ed = _residual_flat(res.p, coh, vis, mask, ant_p, ant_q, chunk_map, None)
         sqrt_w_new, nu_new = update_w_and_nu(ed, nu, nulow, nuhigh, mask=mask8)
         return (res.p, nu_new, sqrt_w_new), res.cost
 
@@ -108,7 +109,7 @@ def robust_lm_solve(
     # first M-step is unweighted, robustlm.c:2231-2257 — safe there only
     # because SAGE hands it a warm start from the previous tile; from a
     # cold start the unweighted fit can lock the EM into a bad basin.)
-    ed0 = _residual_rows(p0, coh, vis, mask, ant_p, ant_q, chunk_map, None)
+    ed0 = _residual_flat(p0, coh, vis, mask, ant_p, ant_q, chunk_map, None)
     sqrt_w0, nu_e = update_w_and_nu(
         ed0, jnp.asarray(nu0, p0.dtype), nulow, nuhigh, mask=mask8
     )

@@ -95,17 +95,17 @@ def _project(x, z):
 # ---------------------------------------------------------------------------
 
 def _model_rows(x, coh, ant_p, ant_q):
-    jp = x[ant_p]  # (rows, 2, 2)
-    jq = x[ant_q]
-    return jp[:, None] @ coh @ jnp.conj(jnp.swapaxes(jq, -1, -2))[:, None]
+    from sagecal_tpu.core.types import corrupt_flat
+
+    return corrupt_flat(x, coh, ant_p, ant_q)
 
 
 def _make_fns(vis, coh, rowmask, ant_p, ant_q, sqrt_w, admm=None):
     """Build (cost, grad, hess) closures for one chunk lane.
 
-    vis/coh: (rows, F, 2, 2) complex; rowmask: (rows, F) —
+    vis/coh: flat (F, 4, rows) complex; rowmask: (F, rows) —
     already restricted to this chunk's rows; sqrt_w: optional robust
-    sqrt-weights with vis's shape (broadcastable).
+    sqrt-weights broadcastable against (F, 4, rows).
 
     ``admm``: optional (Yc, BZc, rho) consensus terms ((N,2,2) complex
     Lagrange multipliers / target, scalar penalty): the augmented cost
@@ -127,13 +127,13 @@ def _make_fns(vis, coh, rowmask, ant_p, ant_q, sqrt_w, admm=None):
         )
 
     def cost_c(x):
-        res = (vis - _model_rows(x, coh, ant_p, ant_q)) * rowmask[..., None, None]
+        res = (vis - _model_rows(x, coh, ant_p, ant_q)) * rowmask[..., None, :]
         if sqrt_w is not None:
             res = res * sqrt_w
         return jnp.sum(jnp.real(res) ** 2 + jnp.imag(res) ** 2) + admm_cost(x)
 
     def data_cost_c(x):
-        res = (vis - _model_rows(x, coh, ant_p, ant_q)) * rowmask[..., None, None]
+        res = (vis - _model_rows(x, coh, ant_p, ant_q)) * rowmask[..., None, :]
         if sqrt_w is not None:
             res = res * sqrt_w
         return jnp.sum(jnp.real(res) ** 2 + jnp.imag(res) ** 2)
@@ -182,8 +182,8 @@ def _make_fns(vis, coh, rowmask, ant_p, ant_q, sqrt_w, admm=None):
 
 def _station_iw(rowmask, ant_p, ant_q, N):
     """Inverse baseline-count weights, scaled to max 1
-    (fns_fcount, rtr_solve.c:99-180)."""
-    good = (jnp.sum(rowmask, axis=-1) > 0).astype(rowmask.dtype)
+    (fns_fcount, rtr_solve.c:99-180).  rowmask: (F, rows)."""
+    good = (jnp.sum(rowmask, axis=0) > 0).astype(rowmask.dtype)
     cnt = jnp.zeros((N,), rowmask.dtype).at[ant_p].add(good).at[ant_q].add(good)
     iw = jnp.where(cnt > 0, 1.0 / jnp.maximum(cnt, 1), 0.0)
     mx = jnp.max(iw)
@@ -448,7 +448,7 @@ def _chunked(solver):
             )
 
             def lane(c, x0_c, y_c, bz_c, r_c):
-                rowmask = mask * (chunk_map == c)[:, None].astype(mask.dtype)
+                rowmask = mask * (chunk_map == c)[None, :].astype(mask.dtype)
                 return solver(
                     vis, coh, rowmask, ant_p, ant_q, x0_c, *args,
                     admm=(y_c, bz_c, r_c), **kwargs,
@@ -458,7 +458,7 @@ def _chunked(solver):
         else:
 
             def lane(c, x0_c):
-                rowmask = mask * (chunk_map == c)[:, None].astype(mask.dtype)
+                rowmask = mask * (chunk_map == c)[None, :].astype(mask.dtype)
                 return solver(
                     vis, coh, rowmask, ant_p, ant_q, x0_c, *args, **kwargs
                 )
@@ -479,7 +479,7 @@ def rtr_solve(
     """Batched-over-chunks RTR solve (``rtr_solve_nocuda``, Dirac.h:1132).
 
     Args mirror :func:`sagecal_tpu.solvers.lm.lm_solve`; ``sqrt_weights``
-    optional (rows, F, 2, 2)-broadcastable robust sqrt-weights;
+    optional (F, 4, rows)-broadcastable robust sqrt-weights;
     ``itmax_dynamic`` optional traced per-call iteration budget (the
     SAGE driver's weighted allocation).  ``admm_y/admm_bz`` (nchunk, 8N)
     + scalar ``admm_rho`` switch on the consensus-augmented cost
@@ -516,23 +516,21 @@ def _robust_weights_and_nu(
     residual elements with an AECM p=2 nu update
     (rtr_solve_robust.c:258, update_nu(...,2,...) at :374; the 8-variate
     sum form on :257 is commented out there)."""
-    from sagecal_tpu.core.types import params_to_jones as _p2j
+    from sagecal_tpu.core.types import corrupt_flat, params_to_jones as _p2j
     from sagecal_tpu.solvers.robust import update_nu_aecm
 
     x = _p2j(p)  # (nchunk, N, 2, 2)
-    jp = x[chunk_map, ant_p]
-    jq = x[chunk_map, ant_q]
-    model = jp[:, None] @ coh @ jnp.conj(jnp.swapaxes(jq, -1, -2))[:, None]
-    res = (vis - model) * mask[..., None, None]
+    model = corrupt_flat(x, coh, ant_p, ant_q, chunk_map)
+    res = (vis - model) * mask[..., None, :]
     e2 = jnp.max(
-        jnp.real(res) ** 2 + jnp.imag(res) ** 2, axis=(-1, -2)
-    )  # (rows, F): max over the 4 complex elements
+        jnp.real(res) ** 2 + jnp.imag(res) ** 2, axis=-2
+    )  # (F, rows): max over the 4 complex elements
     w = (nu + 2.0) / (nu + e2)
     w = jnp.where(mask > 0, w, 1.0)
     msum = jnp.maximum(jnp.sum(mask), 1.0)
     logsumw = jnp.sum((jnp.log(w) - w) * mask) / msum
     nu1 = update_nu_aecm(logsumw, nu, p=2, nulow=nulow, nuhigh=nuhigh)
-    return jnp.sqrt(w)[..., None, None], nu1
+    return jnp.sqrt(w)[..., None, :], nu1
 
 
 def rtr_solve_robust(

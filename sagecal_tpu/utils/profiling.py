@@ -1,0 +1,82 @@
+"""First-class profiling: per-phase wall-clock + optional XLA traces.
+
+The reference's only instrumentation is one ``time(0)`` print per tile
+(``/root/reference/src/MS/fullbatch_mode.cpp:276,309,634-635``); SURVEY
+section 5 makes ``jax.profiler`` traces + per-phase timing a first-class
+feature of the rebuild.  Two layers:
+
+- :class:`PhaseTimer` — cheap always-on wall-clock accounting per named
+  phase (load / coherencies / solve / residual / write), printed as one
+  summary line per tile and totals at the end of a run.
+- XLA device traces — set ``SAGECAL_PROFILE_DIR=/some/dir`` (or call
+  :func:`start_trace` yourself) to capture a TensorBoard-loadable
+  ``jax.profiler`` trace of the same run; phases are annotated with
+  ``jax.profiler.TraceAnnotation`` so device ops attribute to them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+import jax
+
+_TRACE_DIR_ENV = "SAGECAL_PROFILE_DIR"
+_active_trace: Optional[str] = None
+
+
+def start_trace(log_dir: Optional[str] = None) -> Optional[str]:
+    """Begin an XLA profiler trace (idempotent).  Returns the directory
+    or None when tracing is not requested."""
+    global _active_trace
+    if _active_trace is not None:
+        return _active_trace
+    log_dir = log_dir or os.environ.get(_TRACE_DIR_ENV)
+    if not log_dir:
+        return None
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    _active_trace = log_dir
+    return log_dir
+
+
+def stop_trace() -> None:
+    global _active_trace
+    if _active_trace is not None:
+        jax.profiler.stop_trace()
+        _active_trace = None
+
+
+class PhaseTimer:
+    """Accumulates wall-clock per named phase across tiles."""
+
+    def __init__(self) -> None:
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+        self._tile: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation(name):
+            yield
+        dt = time.perf_counter() - t0
+        self.totals[name] += dt
+        self.counts[name] += 1
+        self._tile[name] = self._tile.get(name, 0.0) + dt
+
+    def tile_summary(self) -> str:
+        """One-line per-tile breakdown; resets the per-tile window."""
+        s = " ".join(f"{k}={v:.2f}s" for k, v in self._tile.items())
+        self._tile = {}
+        return s
+
+    def run_summary(self) -> str:
+        parts = [
+            f"{k}: {self.totals[k]:.2f}s/{self.counts[k]}x"
+            for k in sorted(self.totals, key=self.totals.get, reverse=True)
+        ]
+        return "phase totals: " + ", ".join(parts)

@@ -68,13 +68,13 @@ class TestDataset:
             m = ds.meta
             assert m.nstations == 7 and m.ntime == 4 and m.nchan == 2
             tile = ds.load_tile(0, 2, average_channels=True)
-            assert tile.vis.shape == (2 * 21, 1, 2, 2)
+            assert tile.vis.shape == (1, 4, 2 * 21)  # flat (F, 4, rows)
             full = ds.load_tile(0, 2, average_channels=False)
-            assert full.vis.shape == (2 * 21, 2, 2, 2)
+            assert full.vis.shape == (2, 4, 2 * 21)
             # averaged == mean over channels (no flags)
             np.testing.assert_allclose(
-                np.asarray(tile.vis[:, 0]),
-                np.asarray(full.vis).mean(axis=1),
+                np.asarray(tile.vis[0]),
+                np.asarray(full.vis).mean(axis=0),
                 rtol=1e-12,
             )
 
@@ -95,8 +95,12 @@ class TestDataset:
         p = tmp_path / "d.h5"
         _make_dataset(p)
         with VisDataset(str(p), "r+") as ds:
+            from sagecal_tpu.core.types import mat_of_flat
+
             full = ds.load_tile(0, 2, average_channels=False)
-            ds.write_tile(0, np.asarray(full.vis) * 0.5, column="corrected")
+            ds.write_tile(
+                0, np.asarray(mat_of_flat(full.vis)) * 0.5, column="corrected"
+            )
             import h5py
 
             assert "corrected" in ds._f
@@ -216,6 +220,7 @@ class TestBeamAndFlags:
         with pytest.raises(ValueError, match="beam"):
             run_fullbatch(cfg, log=lambda *a: None)
 
+    @pytest.mark.slow
     def test_per_channel_refit(self, workdir):
         """-b: per-channel re-fit lowers the per-channel residual vs the
         averaged-solution residual when gains vary across channels."""
@@ -240,6 +245,7 @@ class TestBeamAndFlags:
         # per-channel refit should not be worse
         assert np.linalg.norm(res_pc) <= np.linalg.norm(res_avg) * 1.05
 
+    @pytest.mark.slow
     def test_skip_and_max_tiles(self, workdir):
         dsp = workdir / "d.h5"
         jones = random_jones(2, 7, seed=3, amp=0.1, dtype=np.complex128)
@@ -281,6 +287,7 @@ class TestBeamAndFlags:
 
 
 class TestMinibatchApp:
+    @pytest.mark.slow
     def test_bandpass_minibatch(self, workdir):
         dsp = workdir / "d.h5"
         jones = random_jones(2, 7, seed=4, amp=0.1, dtype=np.complex128)
@@ -297,6 +304,7 @@ class TestMinibatchApp:
         for r0, r1 in results:
             assert r1 < 0.3 * r0, (r0, r1)
 
+    @pytest.mark.slow
     def test_band_consensus(self, workdir):
         dsp = workdir / "d.h5"
         jones = random_jones(2, 7, seed=5, amp=0.1, dtype=np.complex128)

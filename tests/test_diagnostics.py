@@ -1,0 +1,126 @@
+"""Influence-function diagnostics vs an independent autodiff oracle.
+
+The module under test assembles H = dg/dvec(J), AdV = -dg/dV(pattern)
+and dR by closed-form kron/einsum blocks (mirroring
+influence_function.cu).  The oracle here recomputes the same objects by
+extracting the holomorphic part of jvp's of the Wirtinger gradient —
+an independent mechanism that catches index/sign/vec-layout mistakes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sagecal_tpu.core.types import corrupt_flat, jones_to_params, params_to_jones
+from sagecal_tpu.io.simulate import corrupt_and_observe, make_visdata, random_jones
+from sagecal_tpu.ops.diagnostics import (
+    _cluster_hessian,
+    _condition_diag,
+    influence_function,
+)
+from sagecal_tpu.ops.rime import point_source_batch
+from sagecal_tpu.solvers.sage import build_cluster_data
+
+
+def _setup(N=4, T=2, seed=0):
+    d = make_visdata(nstations=N, tilesz=T, nchan=1, seed=seed, dtype=np.float64)
+    src = point_source_batch([0.01], [-0.02], [2.0], dtype=jnp.float64)
+    J = random_jones(1, N, seed=seed + 1, amp=0.2, dtype=np.complex128)
+    obs = corrupt_and_observe(d, [src], jones=J, noise_sigma=1e-3, seed=seed + 2)
+    cdata = build_cluster_data(obs, [src], [1])
+    p = jones_to_params(J)[:, None, :]  # truth as "solution"
+    return obs, cdata, p, J
+
+
+def _wirtinger_grad(vecX, V_flat, coh_flat, ant_p, ant_q, N):
+    """g = df/dconj(vecX) for f = sum |V - Jp C Jq^H|^2, via 0.5(d/dRe + i d/dIm)."""
+
+    def f_of_ri(xri):
+        x = jax.lax.complex(xri[..., 0], xri[..., 1])
+        jones = x.reshape(2, N, 2).transpose(1, 2, 0)  # vec(c*2N+2s+r) -> (s, r, c)
+        model = corrupt_flat(jones, coh_flat, ant_p, ant_q)
+        r = V_flat - model
+        return jnp.sum(jnp.real(r) ** 2 + jnp.imag(r) ** 2)
+
+    xri = jnp.stack([jnp.real(vecX), jnp.imag(vecX)], -1)
+    gri = jax.grad(f_of_ri)(xri)
+    return 0.5 * jax.lax.complex(gri[..., 0], gri[..., 1])
+
+
+def _holomorphic_jvp(fun, x, t):
+    """A t where d(fun) = A t + B conj(t): extract via jvp at t and i*t."""
+    _, d1 = jax.jvp(fun, (x,), (t,))
+    _, d2 = jax.jvp(fun, (x,), (1j * t,))
+    return 0.5 * (d1 - 1j * d2)
+
+
+class TestHessianOracle:
+    def test_hessian_matches_autodiff(self):
+        obs, cdata, p, J = _setup()
+        N = obs.nstations
+        rows = obs.rows
+        coh0 = cdata.coh[0]  # (F, 4, rows), F=1
+        jones = params_to_jones(p[0])  # (1, N, 2, 2)
+        Jp = jones[0][obs.ant_p]
+        Jq = jones[0][obs.ant_q]
+
+        def mat22(flat_c):
+            return jnp.moveaxis(flat_c, -1, 0).reshape(rows, 2, 2)
+
+        model = corrupt_flat(jones[0], coh0, obs.ant_p, obs.ant_q)
+        Rm = mat22((obs.vis - model)[0])
+        Cm = mat22(coh0[0])
+        H = _cluster_hessian(
+            Cm.astype(jnp.complex64), Rm.astype(jnp.complex64),
+            Jp.astype(jnp.complex64), Jq.astype(jnp.complex64),
+            obs.ant_p, obs.ant_q, N,
+        )
+
+        # oracle: A = dg/dvecX via holomorphic-part extraction, column i
+        vecX = jones[0].transpose(2, 0, 1).reshape(-1)  # (s,r,c)->(c,s,r) vec
+        gfun = lambda x: _wirtinger_grad(
+            x, obs.vis, coh0, obs.ant_p, obs.ant_q, N
+        )
+        cols = []
+        for i in range(4 * N):
+            e = jnp.zeros((4 * N,), jnp.complex128).at[i].set(1.0)
+            cols.append(_holomorphic_jvp(gfun, vecX, e))
+        H_oracle = jnp.stack(cols, axis=1)
+        np.testing.assert_allclose(
+            np.asarray(H), np.asarray(H_oracle), rtol=2e-4, atol=2e-4
+        )
+
+
+class TestInfluence:
+    def test_influence_runs_and_is_finite(self):
+        obs, cdata, p, J = _setup(N=5, T=2)
+        out = influence_function(obs, cdata, p)
+        assert out.shape == (1, 4, obs.rows)
+        assert np.all(np.isfinite(out.real)) and np.all(np.isfinite(out.imag))
+        # non-trivial: calibration must have leverage on some baselines
+        assert np.abs(out).max() > 1e-8
+
+    def test_eigenvalue_sum_equals_trace(self):
+        """sum of influence eigenvalues per correlation == trace of the
+        baseline-to-baseline sensitivity operator (the 'total leverage'
+        conservation the eigen-decomposition must preserve)."""
+        import sagecal_tpu.ops.diagnostics as diag
+
+        obs, cdata, p, J = _setup(N=5, T=3)
+        # recompute dR by monkeypatching np.linalg.eigvals to capture input
+        captured = {}
+        orig = np.linalg.eigvals
+
+        def capture(mat):
+            lam = orig(mat)
+            captured.setdefault("traces", []).append(np.trace(mat))
+            captured.setdefault("sums", []).append(lam.sum())
+            return lam
+
+        np.linalg.eigvals = capture
+        try:
+            influence_function(obs, cdata, p)
+        finally:
+            np.linalg.eigvals = orig
+        for tr, s in zip(captured["traces"], captured["sums"]):
+            np.testing.assert_allclose(s, tr, rtol=1e-4, atol=1e-6)

@@ -1,0 +1,115 @@
+"""End-to-end distributed (sagecal-mpi equivalent) driver test:
+multi-band synthetic observation -> mesh consensus ADMM -> global-Z
+solution file + per-band solutions + residual write-back."""
+
+import math
+import os
+
+import h5py
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sagecal_tpu.apps.config import RunConfig
+from sagecal_tpu.apps.distributed import run_distributed
+from sagecal_tpu.io import solutions as solio
+from sagecal_tpu.io.dataset import simulate_dataset
+from sagecal_tpu.io.simulate import random_jones
+from sagecal_tpu.io.skymodel import load_sky
+
+SKY = """P1 0 0 0.0 51 0 0.0 2.0 0 0 0 0 0 0 0 0 0 0 150e6
+P2 0 2 0.0 50 30 0.0 1.0 0 0 0 0 0 0 0 0 0 0 150e6
+"""
+CLUSTER = "1 1 P1\n2 1 P2\n"
+
+
+def _make_bands(tmp_path, Nf=4, nstations=7, ntime=2, seed=5):
+    """Nf band datasets with gains LINEAR in frequency."""
+    sky = tmp_path / "t.sky.txt"
+    sky.write_text(SKY)
+    (tmp_path / "t.sky.txt.cluster").write_text(CLUSTER)
+    clusters, _ = load_sky(str(sky), str(sky) + ".cluster",
+                           0.0, math.radians(51.0), dtype=np.float64)
+    rng = np.random.default_rng(seed)
+    M, N = 2, nstations
+    eye = np.eye(2)[None, None]
+    Z0 = eye + 0.2 * (rng.standard_normal((M, N, 2, 2))
+                      + 1j * rng.standard_normal((M, N, 2, 2)))
+    Z1 = 0.1 * (rng.standard_normal((M, N, 2, 2))
+                + 1j * rng.standard_normal((M, N, 2, 2)))
+    freqs = np.linspace(130e6, 170e6, Nf)
+    f0 = 150e6
+    paths = []
+    for f in range(Nf):
+        frat = (freqs[f] - f0) / f0
+        jones = jnp.asarray(Z0 + frat * Z1)
+        p = tmp_path / f"band{f}.h5"
+        simulate_dataset(
+            str(p), nstations=N, ntime=ntime, nchan=1,
+            freq0=freqs[f], clusters=clusters, jones=jones,
+            noise_sigma=1e-4, seed=seed + f, dec0=math.radians(51.0),
+        )
+        with h5py.File(str(p), "r+") as fh:
+            fh.attrs["ra0"] = 0.0
+            fh.attrs["dec0"] = math.radians(51.0)
+        paths.append(str(p))
+    return paths, sky
+
+
+class TestDistributedDriver:
+    def test_e2e_multiband(self, tmp_path, devices8):
+        Nf = 4
+        paths, sky = _make_bands(tmp_path, Nf=Nf)
+        solf = str(tmp_path / "zsol.txt")
+        cfg = RunConfig(
+            dataset=str(tmp_path / "band*.h5"),
+            sky_model=str(sky),
+            cluster_file=str(sky) + ".cluster",
+            out_solutions=solf,
+            tilesz=2, max_emiter=1, max_iter=6, npoly=2,
+            admm_iters=5, admm_rho=10.0, solver_mode=1,
+        )
+        traces = run_distributed(cfg, log=lambda *a: None)
+        assert len(traces) == 1  # one tile
+        dres, pres = traces[0]
+        assert np.all(np.isfinite(dres)) and np.all(np.isfinite(pres))
+        assert pres[-1] < 0.2, pres
+
+        # global Z file: header + N*8*Npoly rows per tile, effective
+        # clusters in reverse order (sagecal_master.cpp:1165-1175)
+        lines = [ln for ln in open(solf) if not ln.startswith("#")]
+        hdr = lines[0].split()
+        assert int(hdr[1]) == 2 and int(hdr[2]) == 7  # Npoly, N
+        body = lines[1:]
+        assert len(body) == 7 * 8 * 2  # N*8*Npoly rows for the one tile
+        ncols = len(body[0].split())
+        assert ncols == 1 + 2  # row index + M*nchunk_max effective cols
+
+        # per-band solution files parse with the standard reader
+        for i in range(Nf):
+            meta, jsol = solio.read_solutions(f"{solf}.band{i}")
+            assert jsol.shape == (1, 2, 7, 2, 2)
+
+        # residuals written back and smaller than the data
+        with h5py.File(paths[0], "r") as fh:
+            assert "corrected" in fh
+            res = np.asarray(fh["corrected"])
+            vis = np.asarray(fh["vis"])
+            assert np.linalg.norm(res) < 0.35 * np.linalg.norm(vis)
+
+    def test_band_padding_to_mesh_multiple(self, tmp_path, devices8):
+        """3 bands on a mesh that wants multiples: zero-weight padding
+        bands must not change the real bands' solve."""
+        paths, sky = _make_bands(tmp_path, Nf=3)
+        solf = str(tmp_path / "zsol.txt")
+        cfg = RunConfig(
+            dataset=str(tmp_path / "band*.h5"),
+            sky_model=str(sky), cluster_file=str(sky) + ".cluster",
+            out_solutions=solf,
+            tilesz=2, max_emiter=1, max_iter=5, npoly=2,
+            admm_iters=3, admm_rho=10.0, solver_mode=1,
+        )
+        traces = run_distributed(cfg, log=lambda *a: None)
+        assert len(traces) == 1
+        for i in range(3):
+            assert os.path.exists(f"{solf}.band{i}")

@@ -1,0 +1,46 @@
+"""PPM spatial-model image output."""
+
+import numpy as np
+
+from sagecal_tpu.utils.ppm import (
+    _colormap,
+    convert_tensor_to_image,
+    plot_spatial_model,
+    write_ppm,
+)
+
+
+class TestPPM:
+    def test_colormap_ramp_endpoints(self):
+        rgb = _colormap(np.asarray([0.0, 0.33, 0.66, 1.0]))
+        np.testing.assert_array_equal(rgb[0], [0, 0, 0])        # v=0
+        assert rgb[1, 2] > 0 and rgb[1, 0] == 0                  # blue-ish
+        assert rgb[2, 1] > 0                                     # green zone
+        np.testing.assert_array_equal(rgb[3], [255, 0, 0])       # v=767
+
+    def test_write_ppm_header_and_size(self, tmp_path):
+        p = str(tmp_path / "x.ppm")
+        write_ppm(p, np.random.default_rng(0).uniform(size=(5, 7)))
+        data = open(p, "rb").read()
+        assert data.startswith(b"P6\n7 5 255\n")
+        assert len(data) == len(b"P6\n7 5 255\n") + 5 * 7 * 3
+
+    def test_tensor_panels(self, tmp_path):
+        p = str(tmp_path / "t.ppm")
+        W = np.random.default_rng(1).standard_normal((5, 4, 4))
+        convert_tensor_to_image(W, p)
+        data = open(p, "rb").read()
+        # 5 panels -> 3x3 grid of 4x4 patches = 12x12 image
+        assert data.startswith(b"P6\n12 12 255\n")
+
+    def test_plot_spatial_model(self, tmp_path):
+        rng = np.random.default_rng(2)
+        N, npoly, n0 = 4, 2, 2
+        G = n0 * n0
+        Z = rng.standard_normal((2 * npoly * N, 2 * G)) + 1j * rng.standard_normal(
+            (2 * npoly * N, 2 * G)
+        )
+        p = str(tmp_path / "sp.ppm")
+        plot_spatial_model(Z, npoly, N, n0, beta=0.05, path=p, npix=16)
+        data = open(p, "rb").read()
+        assert data.startswith(b"P6\n32 32 255\n")  # 2x2 panels of 16px

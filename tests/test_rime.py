@@ -2,6 +2,7 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.special
 
+from sagecal_tpu.core.types import mat_of_flat
 from sagecal_tpu.io.simulate import make_visdata
 from sagecal_tpu.ops.rime import (
     ST_DISK,
@@ -9,10 +10,16 @@ from sagecal_tpu.ops.rime import (
     ST_RING,
     SourceBatch,
     point_source_batch,
-    predict_coherencies,
+    predict_coherencies as _predict_flat,
     uv_cut_mask,
 )
 from sagecal_tpu.ops.special import bessel_j0, bessel_j1, sinc_abs
+
+
+def predict_coherencies(*args, **kwargs):
+    """Mat-form (rows, F, 2, 2) view of the flat predict, so the
+    closed-form oracles below keep their natural matrix indexing."""
+    return mat_of_flat(_predict_flat(*args, **kwargs))
 
 
 def test_bessel_vs_scipy():

@@ -32,11 +32,11 @@ def _setup(nstations=8, noise=1e-4, seed=0, amp=0.25, outliers=0):
     jones = random_jones(1, nstations, seed=seed, amp=amp, dtype=np.complex128)
     data = corrupt_and_observe(data, clusters, jones=jones, noise_sigma=noise, seed=seed)
     if outliers:
-        vis = np.array(data.vis)
+        vis = np.array(data.vis)  # (F, 4, rows)
         rng = np.random.default_rng(42)
-        idx = rng.choice(vis.shape[0], outliers, replace=False)
-        vis[idx] += 25.0 * (rng.standard_normal((outliers, 1, 2, 2))
-                            + 1j * rng.standard_normal((outliers, 1, 2, 2)))
+        idx = rng.choice(vis.shape[-1], outliers, replace=False)
+        vis[..., idx] += 25.0 * (rng.standard_normal((1, 4, outliers))
+                                 + 1j * rng.standard_normal((1, 4, outliers)))
         data = data.replace(vis=jnp.asarray(vis))
     cdata = build_cluster_data(data, clusters, [1])
     p0 = jones_to_params(random_jones(1, nstations, seed=99, amp=0.0,

@@ -181,9 +181,10 @@ class TestShapeletInPredict:
                 np.zeros(rows), mdl, use_projection=True,
             )
         )
-        np.testing.assert_allclose(out[:, 0, 0, 0], expect, rtol=1e-4)
-        np.testing.assert_allclose(out[:, 0, 1, 1], expect, rtol=1e-4)
-        np.testing.assert_allclose(out[:, 0, 0, 1], 0.0, atol=1e-7)
+        # flat layout (F, 4, rows): components [XX, XY, YX, YY] on axis -2
+        np.testing.assert_allclose(out[0, 0], expect, rtol=1e-4)
+        np.testing.assert_allclose(out[0, 3], expect, rtol=1e-4)
+        np.testing.assert_allclose(out[0, 1], 0.0, atol=1e-7)
 
 
 class TestTransforms:

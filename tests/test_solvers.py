@@ -65,10 +65,10 @@ def _simulated_single_cluster(nst=7, tilesz=2, noise=0.0, seed=3):
 
 def _gain_consistency_err(j_est, j_true, coh, ant_p, ant_q):
     """Compare J_p C J_q^H predictions (gauge-invariant comparison)."""
-    from sagecal_tpu.core.types import apply_gains
+    from sagecal_tpu.core.types import corrupt_flat
 
-    m1 = apply_gains(j_est, coh, ant_p, ant_q)
-    m2 = apply_gains(j_true, coh, ant_p, ant_q)
+    m1 = corrupt_flat(j_est, coh, ant_p, ant_q)
+    m2 = corrupt_flat(j_true, coh, ant_p, ant_q)
     return float(jnp.max(jnp.abs(m1 - m2)) / jnp.max(jnp.abs(m2)))
 
 
@@ -76,7 +76,7 @@ def test_lm_recovers_jones():
     d, obs, coh, J = _simulated_single_cluster()
     nst = d.nstations
     p0 = jones_to_params(identity_jones(nst))[None]  # (1, 8N)
-    chunk_map = jnp.zeros((obs.vis.shape[0],), jnp.int32)
+    chunk_map = jnp.zeros((d.rows,), jnp.int32)
     res = lm_solve(
         obs.vis, coh, obs.mask, obs.ant_p, obs.ant_q, chunk_map, p0,
         LMConfig(itmax=30),
@@ -98,12 +98,10 @@ def test_lm_hybrid_chunks():
     )
     coh = predict_coherencies(d.u, d.v, d.w, d.freqs, src)
     J2 = random_jones(2, 6, seed=12, amp=0.15)  # one per chunk
-    from sagecal_tpu.core.types import apply_gains
+    from sagecal_tpu.core.types import corrupt_flat
 
     chunk_map = d.time_idx  # timeslot == chunk
-    jp = J2[chunk_map, d.ant_p]
-    jq = J2[chunk_map, d.ant_q]
-    vis = jp[:, None] @ coh @ jnp.conj(jnp.swapaxes(jq, -1, -2))[:, None]
+    vis = corrupt_flat(J2, coh, d.ant_p, d.ant_q, chunk_map)
     p0 = jnp.broadcast_to(jones_to_params(identity_jones(6))[None], (2, 8 * 6))
     res = lm_solve(vis, coh, d.mask, d.ant_p, d.ant_q, chunk_map, p0, LMConfig(itmax=30))
     assert np.all(np.asarray(res.cost) < 1e-5 * np.asarray(res.cost0))
@@ -112,7 +110,7 @@ def test_lm_hybrid_chunks():
 def test_os_lm_reduces_cost():
     d, obs, coh, J = _simulated_single_cluster(nst=8, tilesz=2)
     p0 = jones_to_params(identity_jones(8))[None]
-    chunk_map = jnp.zeros((obs.vis.shape[0],), jnp.int32)
+    chunk_map = jnp.zeros((d.rows,), jnp.int32)
     res = os_lm_solve(
         obs.vis, coh, obs.mask, obs.ant_p, obs.ant_q, chunk_map, p0,
         LMConfig(itmax=16), nsubsets=4,
@@ -133,14 +131,15 @@ def test_update_w_and_nu():
 
 def test_robust_lm_with_outliers():
     d, obs, coh, J = _simulated_single_cluster(nst=7, tilesz=2, noise=1e-3)
-    # inject gross outliers into 5% of rows
+    # inject gross outliers into 5% of rows (flat layout: rows on axis -1)
     rng = np.random.default_rng(9)
-    vis = np.asarray(obs.vis).copy()
-    bad = rng.choice(vis.shape[0], size=vis.shape[0] // 20, replace=False)
-    vis[bad] += 50.0 * (rng.standard_normal((len(bad), 1, 2, 2)) + 1j)
+    vis = np.asarray(obs.vis).copy()  # (F, 4, rows)
+    rows = vis.shape[-1]
+    bad = rng.choice(rows, size=rows // 20, replace=False)
+    vis[..., bad] += 50.0 * (rng.standard_normal((1, 4, len(bad))) + 1j)
     visj = jnp.asarray(vis)
     p0 = jones_to_params(identity_jones(7))[None]
-    chunk_map = jnp.zeros((vis.shape[0],), jnp.int32)
+    chunk_map = jnp.zeros((rows,), jnp.int32)
     res_r, nu = robust_lm_solve(
         visj, coh, obs.mask, obs.ant_p, obs.ant_q, chunk_map, p0,
         em_iters=3, config=LMConfig(itmax=20),

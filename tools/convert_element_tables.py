@@ -1,0 +1,92 @@
+"""Convert the reference's element-beam coefficient headers to npz tables.
+
+The LOFAR LBA/HBA and lunar ALO spherical-wave coefficient DATA live in
+generated C headers (``/root/reference/src/lib/Radio/elementcoeff.h`` /
+``elementcoeff_ALO.h``, produced by ``scripts/beam_models/
+create_header.py`` from the published beam models).  This script parses
+the numeric tables (coefficients are measurement-derived data, not
+code) into the framework's loadable ``.npz`` format under
+``sagecal_tpu/data/element/``.
+
+Usage:  python tools/convert_element_tables.py [reference_dir]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+import numpy as np
+
+_CPLX = re.compile(
+    r"([+-]?[0-9.]+e?[+-]?[0-9]*)\s*\+\s*_Complex_I\s*\*\s*\(\s*([+-]?[0-9.]+e?[+-]?[0-9]*)\s*\)"
+)
+
+
+def _parse_define(text, name, cast=float):
+    m = re.search(rf"#define\s+{name}\s+([0-9.eE+-]+)", text)
+    return cast(m.group(1)) if m else None
+
+
+def _parse_real_array(text, name, count):
+    m = re.search(
+        rf"{name}\[[0-9]+\]\s*=\s*\{{(.*?)\}};", text, re.S
+    )
+    vals = [float(v) for v in re.findall(r"[0-9.eE+-]+", m.group(1))]
+    assert len(vals) == count, (name, len(vals), count)
+    return np.asarray(vals)
+
+
+def _parse_complex_table(text, name, nfreq, nmodes):
+    m = re.search(
+        rf"{name}\[[0-9]+\]\[[0-9]+\]\s*=\s*\{{(.*?)\}};", text, re.S
+    )
+    pairs = _CPLX.findall(m.group(1))
+    assert len(pairs) == nfreq * nmodes, (name, len(pairs), nfreq * nmodes)
+    z = np.asarray([complex(float(a), float(b)) for a, b in pairs])
+    return z.reshape(nfreq, nmodes)
+
+
+def convert(ref_dir: str, out_dir: str) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    # LOFAR LBA + HBA share elementcoeff.h
+    text = open(os.path.join(ref_dir, "src/lib/Radio/elementcoeff.h")).read()
+    M = _parse_define(text, "BEAM_ELEM_MODES", int)
+    beta = _parse_define(text, "BEAM_ELEM_BETA")
+    K = M * (M + 1) // 2
+    for kind in ("lba", "hba"):
+        nf = _parse_define(text, f"{kind.upper()}_FREQS", int)
+        freqs = _parse_real_array(text, f"{kind}_beam_elem_freqs", nf)
+        theta = _parse_complex_table(text, f"{kind}_beam_elem_theta", nf, K)
+        phi = _parse_complex_table(text, f"{kind}_beam_elem_phi", nf, K)
+        np.savez(
+            os.path.join(out_dir, f"{kind}.npz"),
+            freqs_ghz=freqs, theta=theta, phi=phi, M=M, beta=beta,
+        )
+        print(f"{kind}: M={M} beta={beta} {nf} freqs x {K} modes")
+    # lunar ALO
+    text = open(
+        os.path.join(ref_dir, "src/lib/Radio/elementcoeff_ALO.h")
+    ).read()
+    M = _parse_define(text, "ALO_BEAM_ELEM_MODES", int)
+    beta = _parse_define(text, "ALO_BEAM_ELEM_BETA")
+    K = M * (M + 1) // 2
+    nf = _parse_define(text, "ALO_FREQS", int)
+    freqs = _parse_real_array(text, "alo_beam_elem_freqs", nf)
+    theta = _parse_complex_table(text, "alo_beam_elem_theta", nf, K)
+    phi = _parse_complex_table(text, "alo_beam_elem_phi", nf, K)
+    np.savez(
+        os.path.join(out_dir, "alo.npz"),
+        freqs_ghz=freqs, theta=theta, phi=phi, M=M, beta=beta,
+    )
+    print(f"alo: M={M} beta={beta} {nf} freqs x {K} modes")
+
+
+if __name__ == "__main__":
+    ref = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "sagecal_tpu", "data", "element",
+    )
+    convert(ref, out)
