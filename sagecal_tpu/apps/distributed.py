@@ -256,8 +256,12 @@ def _run_distributed_inner(
         ntiles_done += 1
         tic = time.time()
         datas, cdatas, fratios = [], [], []
+        # clamp the tile to the COMMON timeslot range so bands with more
+        # timeslots than ntime_min still produce equal row counts on the
+        # final partial tile (stack_for_mesh needs identical shapes)
+        eff_tilesz = min(cfg.tilesz, ntime - t0)
         for h in handles:
-            d = h.load_tile(t0, cfg.tilesz, average_channels=True,
+            d = h.load_tile(t0, eff_tilesz, average_channels=True,
                             min_uvcut=cfg.min_uvcut,
                             max_uvcut=cfg.max_uvcut, dtype=dtype)
             # static pytree fields must match across the stacked bands

@@ -412,3 +412,68 @@ def ms_to_h5(ms_path: str, h5_path: str, data_column: str = "DATA") -> None:
         deltat=float(np.median(np.diff(utimes))) if ntime > 1 else 1.0,
         ra0=float(ra0), dec0=float(dec0),
     )
+
+
+def h5_to_ms(
+    h5_path: str,
+    ms_path: str,
+    column: str = "corrected",
+    ms_column: str = "CORRECTED_DATA",
+) -> None:
+    """Write a vis.h5 data column back into a CASA MeasurementSet
+    (requires python-casacore; the ``Data::writeData`` direction,
+    src/MS/data.h:124 / data.cpp).
+
+    ``column``: h5 dataset to export ('vis', 'corrected', 'model',
+    'influence'); ``ms_column``: target MS column, created from the
+    DATA column's description if absent.  Rows are matched by the same
+    (time, baseline) lexsort ordering :func:`ms_to_h5` uses;
+    autocorrelation rows in the MS are left untouched.
+    """
+    if not have_casacore():
+        raise RuntimeError(
+            "python-casacore is not installed; write back on a host "
+            "that has it"
+        )
+    from casacore.tables import table, makecoldesc
+
+    with h5py.File(h5_path, "r") as f:
+        if column not in f:
+            raise KeyError(f"{h5_path} has no column {column!r}")
+        vals = np.asarray(f[column])  # (ntime, nbase, nchan, 2, 2)
+    ntime, nbase, nchan = vals.shape[:3]
+    flat = vals.reshape(ntime * nbase, nchan, 4)
+
+    t = table(ms_path, readonly=False)
+    a1 = t.getcol("ANTENNA1")
+    a2 = t.getcol("ANTENNA2")
+    cross = a1 != a2
+    times = t.getcol("TIME")[cross]
+    order = np.lexsort((a2[cross], a1[cross], times))
+    if order.shape[0] != ntime * nbase:
+        raise ValueError(
+            f"{ms_path}: {order.shape[0]} cross rows != "
+            f"{ntime}x{nbase} in {h5_path}"
+        )
+    if ms_column not in t.colnames():
+        desc = t.getcoldesc("DATA")
+        t.addcols(makecoldesc(ms_column, desc))
+        out = np.asarray(t.getcol("DATA"), np.complex128)
+    else:
+        # seed from the existing target so untouched rows
+        # (autocorrelations) keep their values
+        out = np.asarray(t.getcol(ms_column), np.complex128)
+    ncorr = out.shape[-1]
+    # component axis is [XX, XY, YX, YY]; map by correlation count
+    if ncorr == 4:
+        sel = [0, 1, 2, 3]
+    elif ncorr == 2:
+        sel = [0, 3]  # dual-pol XX, YY
+    elif ncorr == 1:
+        sel = [0]
+    else:
+        raise ValueError(f"{ms_path}: unsupported correlation count {ncorr}")
+    cross_idx = np.flatnonzero(cross)
+    out[cross_idx[order]] = flat.reshape(ntime * nbase, nchan, 4)[:, :, sel]
+    t.putcol(ms_column, out)
+    t.close()

@@ -168,7 +168,7 @@ def make_federated_mesh_fn(
             mesh=mesh,
             in_specs=(fspec, fspec, fspec, fspec, fspec),
             out_specs=(fspec, fspec, rspec),
-            check_vma=False,
+            check_vma=True,
         )
         p, Z, dres = sm(data_stack, cdata_stack, p0, rho, B)
         return FederatedResult(p=p, Z=Z, dual_res=dres)

@@ -363,7 +363,7 @@ def make_admm_mesh_fn(
             mesh=mesh,
             in_specs=(fspec, fspec, fspec, fspec, fspec),
             out_specs=(fspec, fspec, rspec, fspec, rspec, rspec, rspec, rspec),
-            check_vma=False,
+            check_vma=True,
         )
         p, Y, Z, rho_f, dres, pres, Zspat, sres = sm(
             data_stack, cdata_stack, p0, rho, B

@@ -238,8 +238,11 @@ def lbfgs_fit(
         done_next = (~step_ok) | (~grad_ok)
         return ck + 1, x_next, g_next, gradnrm_next, mem1, done_next
 
+    from sagecal_tpu.utils.platform import match_vma
+
     start_done = ~(jnp.isfinite(gradnrm0) & (gradnrm0 > CLM_STOP_THRESH))
     ck, x, g, gradnrm, mem, _ = jax.lax.while_loop(
-        cond, body, (jnp.asarray(0), p0, g0, gradnrm0, memory, start_done)
+        cond, body,
+        match_vma((jnp.asarray(0), p0, g0, gradnrm0, memory, start_done), p0),
     )
     return LBFGSResult(p=x, memory=mem, cost=cost_fn(x), gradnorm=gradnrm, iterations=ck)

@@ -165,10 +165,12 @@ def _assemble_normal_eq(p_all, coh, vis, mask, ant_p, ant_q, chunk_map, nchunk, 
         JTe = JTe.at[cm, aq].add(gq)
         return (JTJ, JTe), None
 
+    from sagecal_tpu.utils.platform import match_vma
+
     JTJ0 = jnp.zeros((nchunk, N, N, 8, 8), dtype)
     JTe0 = jnp.zeros((nchunk, N, 8), dtype)
     (JTJ, JTe), _ = jax.lax.scan(
-        block, (JTJ0, JTe0), (coh_b, mask_b, e_b, ap_b, aq_b, cm_b, sw_b)
+        block, match_vma((JTJ0, JTe0), e), (coh_b, mask_b, e_b, ap_b, aq_b, cm_b, sw_b)
     )
     JTJ = JTJ.transpose(0, 1, 3, 2, 4).reshape(nchunk, 8 * N, 8 * N)
     JTe = JTe.reshape(nchunk, 8 * N)
@@ -311,10 +313,13 @@ def lm_solve(
         done1 = done | (g_inf <= config.eps1) | small_step | (cost1 <= config.eps3)
         return it + 1, p1, cost1, mu1, nu1, done1
 
+    from sagecal_tpu.utils.platform import match_vma
+
     nu0 = jnp.full((nchunk,), 2.0, p0.dtype)
     done0 = jnp.zeros((nchunk,), bool)
     it, p, cost, _, _, _ = jax.lax.while_loop(
-        cond, body, (jnp.asarray(0), p0, cost0, mu0, nu0, done0)
+        cond, body,
+        match_vma((jnp.asarray(0), p0, cost0, mu0, nu0, done0), p0),
     )
     return LMResult(p=p, cost0=cost0, cost=cost, iterations=it)
 

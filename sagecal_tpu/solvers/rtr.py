@@ -254,7 +254,9 @@ def _tcg(x, grad, Delta, hess, cfg: RTRConfig):
             stop=stop,
         )
 
-    out = jax.lax.while_loop(cond, body, state)
+    from sagecal_tpu.utils.platform import match_vma
+
+    out = jax.lax.while_loop(cond, body, match_vma(state, grad))
     return out["eta"], out["Heta"]
 
 
@@ -352,9 +354,15 @@ def _rtr_single(
             stop=gnorm < cfg.epsilon,
         )
 
+    from sagecal_tpu.utils.platform import match_vma
+
     out = jax.lax.while_loop(
         tr_cond, tr_body,
-        dict(k=jnp.asarray(0), x=x, fx=fx, Delta=Delta0, stop=jnp.asarray(False)),
+        match_vma(
+            dict(k=jnp.asarray(0), x=x, fx=fx, Delta=Delta0,
+                 stop=jnp.asarray(False)),
+            x,
+        ),
     )
     # guard: never return something worse than the input
     better = out["fx"] <= fx0
@@ -417,8 +425,14 @@ def _nsd_single(
             keep(theta, theta1), done2,
         ), None
 
+    from sagecal_tpu.utils.platform import match_vma
+
     (x, _, _, _, _, _), _ = jax.lax.scan(
-        body, (x0, x0, g0, t0, jnp.asarray(1.0, t0.dtype), jnp.asarray(False)),
+        body,
+        match_vma(
+            (x0, x0, g0, t0, jnp.asarray(1.0, t0.dtype), jnp.asarray(False)),
+            x0,
+        ),
         jnp.arange(itmax),
     )
     fx = cost_c(x)
@@ -562,8 +576,11 @@ def rtr_solve_robust(
         )
         return (out.p, nu1), (out.cost0, out.cost)
 
+    from sagecal_tpu.utils.platform import match_vma
+
     (p, nu), (c0s, c1s) = jax.lax.scan(
-        em, (p0, jnp.asarray(nu0, p0.dtype)), None, length=em_iters
+        em, match_vma((p0, jnp.asarray(nu0, p0.dtype)), p0), None,
+        length=em_iters
     )
     # re-estimate nu from the FINAL solution (the reference updates the
     # weights/nu once more after the loop, rtr_solve_robust.c:1625)
@@ -600,8 +617,11 @@ def nsd_solve_robust(
         )
         return (out.p, nu1), (out.cost0, out.cost)
 
+    from sagecal_tpu.utils.platform import match_vma
+
     (p, nu), (c0s, c1s) = jax.lax.scan(
-        em, (p0, jnp.asarray(nu0, p0.dtype)), None, length=em_iters
+        em, match_vma((p0, jnp.asarray(nu0, p0.dtype)), p0), None,
+        length=em_iters
     )
     # final-solution nu re-estimate (rtr_solve_robust.c:2104)
     _, nu = _robust_weights_and_nu(

@@ -1,0 +1,4 @@
+"""Offline tools: restore (sky -> FITS image), buildsky (FITS image ->
+sky model), uvwriter (lunar-frame UVW) — the reference's standalone
+binaries (``/root/reference/src/restore``, ``src/buildsky``,
+``src/uvwriter``)."""

@@ -1,0 +1,110 @@
+"""ctypes loader for the native tool core (``native/clusterlib.cpp``).
+
+Builds the shared library on first use with the baked-in g++ (the
+reference's equivalents are compiled C: the embedded C Clustering
+Library and buildsky's island walks).  Falls back to pure numpy/scipy
+implementations when no compiler is available, so the tools never hard-
+fail.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    src = os.path.join(_repo_root(), "native", "clusterlib.cpp")
+    so = os.path.join(_repo_root(), "native", "libsagecal_native.so")
+    try:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", so, src],
+                check=True, capture_output=True, timeout=120,
+            )
+        lib = ctypes.CDLL(so)
+        lib.label_islands.restype = ctypes.c_int
+        lib.kmeans_weighted.restype = ctypes.c_int
+        _LIB = lib
+    except Exception:
+        _LIB = None
+    return _LIB
+
+
+def label_islands(mask: np.ndarray) -> Tuple[np.ndarray, int]:
+    """8-connected labeling: (labels int32 (ny, nx), count)."""
+    mask8 = np.ascontiguousarray(mask.astype(np.int8))
+    ny, nx = mask8.shape
+    lib = _load()
+    if lib is not None:
+        labels = np.zeros((ny, nx), np.int32)
+        n = lib.label_islands(
+            mask8.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            ctypes.c_int(ny), ctypes.c_int(nx),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return labels, int(n)
+    # fallback: scipy 8-connected structure
+    from scipy import ndimage
+
+    labels, n = ndimage.label(mask8, structure=np.ones((3, 3), int))
+    return labels.astype(np.int32), int(n)
+
+
+def kmeans_weighted(
+    x: np.ndarray, y: np.ndarray, w: Optional[np.ndarray], k: int,
+    niter: int = 50, seed: int = 7,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Weighted 2-D k-means: (assignment (n,), centers (k, 2))."""
+    x = np.ascontiguousarray(np.asarray(x, np.float64))
+    y = np.ascontiguousarray(np.asarray(y, np.float64))
+    n = x.shape[0]
+    k = min(max(k, 1), max(n, 1))
+    wv = (np.ascontiguousarray(np.asarray(w, np.float64))
+          if w is not None else None)
+    lib = _load()
+    if lib is not None and n:
+        assign = np.zeros((n,), np.int32)
+        centers = np.zeros((k, 2), np.float64)
+        pd = ctypes.POINTER(ctypes.c_double)
+        lib.kmeans_weighted(
+            x.ctypes.data_as(pd), y.ctypes.data_as(pd),
+            wv.ctypes.data_as(pd) if wv is not None else None,
+            ctypes.c_int(n), ctypes.c_int(k), ctypes.c_int(niter),
+            ctypes.c_uint64(seed),
+            assign.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            centers.ctypes.data_as(pd),
+        )
+        return assign, centers
+    # numpy fallback: plain Lloyd with weighted centroids
+    rng = np.random.default_rng(seed)
+    idx = rng.choice(n, size=k, replace=False)
+    cx, cy = x[idx].copy(), y[idx].copy()
+    wv2 = wv if wv is not None else np.ones(n)
+    assign = np.zeros(n, np.int32)
+    for _ in range(niter):
+        d2 = (x[:, None] - cx[None]) ** 2 + (y[:, None] - cy[None]) ** 2
+        assign = np.argmin(d2, axis=1).astype(np.int32)
+        for c in range(k):
+            m = assign == c
+            if np.any(m):
+                cx[c] = np.average(x[m], weights=wv2[m])
+                cy[c] = np.average(y[m], weights=wv2[m])
+    return assign, np.stack([cx, cy], axis=1)

@@ -109,3 +109,37 @@ def ensure_cpu_devices(n_devices: int) -> None:
                 f"could not create {n_devices} virtual CPU devices "
                 f"(got {_count()}); XLA_FLAGS={os.environ.get('XLA_FLAGS')}"
             )
+
+
+def match_vma(tree, ref):
+    """Promote every array leaf of ``tree`` to carry (at least) the
+    varying-manual-axes of ``ref``.
+
+    Inside ``shard_map(..., check_vma=True)`` (the default the framework
+    now runs with), loop carries initialized from constants (zeros,
+    identity Jones, False flags) are inferred as replicated while the
+    loop bodies produce shard-varying outputs, which the type checker
+    rightly rejects.  This helper inserts the
+    ``jax.lax.pcast(..., to='varying')`` casts the checker asks for —
+    and is a no-op outside shard_map (empty vma) or when already
+    varying, so library solvers stay usable in both worlds."""
+    import jax
+    import jax.tree_util as jtu
+
+    try:
+        ref_vma = jax.typeof(ref).vma
+    except Exception:
+        return tree
+    if not ref_vma:
+        return tree
+
+    def fix(x):
+        try:
+            missing = tuple(n for n in ref_vma if n not in jax.typeof(x).vma)
+        except Exception:
+            return x
+        if not missing:
+            return x
+        return jax.lax.pcast(x, missing, to="varying")
+
+    return jtu.tree_map(fix, tree)

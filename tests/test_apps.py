@@ -345,3 +345,30 @@ class TestCLI:
         ])
         assert rc == 0
         assert (workdir / "sol.txt").exists()
+
+
+class TestMSBridge:
+    def test_h5_to_ms_requires_casacore(self, tmp_path):
+        """Without python-casacore the bridge must fail loudly (not
+        silently no-op); with it, the round trip is exercised."""
+        from sagecal_tpu.io.dataset import h5_to_ms, have_casacore, ms_to_h5
+
+        p = tmp_path / "d.h5"
+        _make_dataset(p)
+        if not have_casacore():
+            with pytest.raises(RuntimeError, match="casacore"):
+                h5_to_ms(str(p), "/nonexistent.ms")
+            with pytest.raises(RuntimeError, match="casacore"):
+                ms_to_h5("/nonexistent.ms", str(tmp_path / "x.h5"))
+            return
+        # casacore available: full round trip (not this CI image)
+        ms = str(tmp_path / "t.ms")
+        h5_to_ms(str(p), ms, column="vis", ms_column="DATA")
+        back = str(tmp_path / "back.h5")
+        ms_to_h5(ms, back)
+        import h5py
+
+        with h5py.File(str(p)) as a, h5py.File(back) as b:
+            np.testing.assert_allclose(
+                np.asarray(a["vis"]), np.asarray(b["vis"]), rtol=1e-6
+            )

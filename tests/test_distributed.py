@@ -113,3 +113,35 @@ class TestDistributedDriver:
         assert len(traces) == 1
         for i in range(3):
             assert os.path.exists(f"{solf}.band{i}")
+
+    def test_unequal_band_lengths_clamp_to_minimum(self, tmp_path, devices8):
+        """Bands with different timeslot counts: the driver must clamp
+        every tile to the common minimum (the warned 'using the minimum'
+        path) instead of crashing on the final partial tile."""
+        import h5py as _h5
+        import math as _math
+        from sagecal_tpu.io.dataset import simulate_dataset as _sim
+        from sagecal_tpu.io.skymodel import load_sky as _ls
+
+        sky = tmp_path / "t.sky.txt"
+        sky.write_text(SKY)
+        (tmp_path / "t.sky.txt.cluster").write_text(CLUSTER)
+        clusters, _ = _ls(str(sky), str(sky) + ".cluster",
+                          0.0, _math.radians(51.0), dtype=np.float64)
+        for i, nt in enumerate((3, 5)):  # unequal ntime
+            p = tmp_path / f"band{i}.h5"
+            _sim(str(p), nstations=7, ntime=nt, nchan=1,
+                 freq0=(140e6, 160e6)[i], clusters=clusters,
+                 noise_sigma=1e-4, seed=i, dec0=_math.radians(51.0))
+            with _h5.File(str(p), "r+") as f:
+                f.attrs["ra0"] = 0.0
+                f.attrs["dec0"] = _math.radians(51.0)
+        cfg = RunConfig(
+            dataset=str(tmp_path / "band*.h5"),
+            sky_model=str(sky), cluster_file=str(sky) + ".cluster",
+            out_solutions=str(tmp_path / "z.txt"),
+            tilesz=2, max_emiter=1, max_iter=4, npoly=2,
+            admm_iters=2, admm_rho=10.0, solver_mode=1,
+        )
+        traces = run_distributed(cfg, log=lambda *a: None)
+        assert len(traces) == 2  # ceil(3/2) tiles over the common range
