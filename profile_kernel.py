@@ -1,0 +1,108 @@
+"""On-chip timing of the fused RIME kernel vs the XLA predict path."""
+
+import time
+
+import numpy as np
+
+import bench
+
+
+def _timeit(fn, args, repeats=3, label=""):
+    float(np.asarray(fn(*args)))  # compile + run (host read = real sync)
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        v = float(np.asarray(fn(*args)))
+        ts.append(time.perf_counter() - t0)
+    dt = float(np.median(ts))
+    print(f"{label:38s} {dt * 1e3:9.2f} ms   (={v:.6g})")
+    return dt
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from sagecal_tpu.core.types import params_to_jones
+    from sagecal_tpu.ops.rime_kernel import (
+        fused_predict_packed, pack_gain_tables, pad_to,
+    )
+    from sagecal_tpu.solvers.lbfgs import lbfgs_fit
+    from sagecal_tpu.utils.platform import cpu_device
+
+    TILE, MC = 512, 8
+    with jax.default_device(cpu_device()):
+        data, cdata, p0 = bench.build_workload(np.float32, bench.TILESZ)
+        M = bench.NCLUSTERS
+        F = data.vis.shape[0]
+        rows = data.vis.shape[-1]
+        mp = pad_to(M, MC)
+        rowsp = pad_to(rows, TILE)
+        coh_ri = np.zeros((mp, F, 8, rowsp), np.float32)
+        coh_ri[:M, :, :4, :rows] = np.asarray(cdata.coh.real)
+        coh_ri[:M, :, 4:, :rows] = np.asarray(cdata.coh.imag)
+        vis_ri = np.zeros((F, 8, rowsp), np.float32)
+        vis_ri[:, :4, :rows] = np.asarray(data.vis.real)
+        vis_ri[:, 4:, :rows] = np.asarray(data.vis.imag)
+        maskp = np.zeros((F, rowsp), np.float32)
+        maskp[:, :rows] = np.asarray(data.mask)
+        antp = np.zeros((1, rowsp), np.int32)
+        antq = np.zeros((1, rowsp), np.int32)
+        antp[0, :rows] = np.asarray(data.ant_p)
+        antq[0, :rows] = np.asarray(data.ant_q)
+        p0_h = np.asarray(p0)
+
+    dev = jax.devices()[0]
+    print("platform:", dev.platform)
+    coh_ri, vis_ri, maskp, antp, antq, p0_d = (
+        jax.device_put(a, dev)
+        for a in (coh_ri, vis_ri, maskp, antp, antq, p0_h)
+    )
+    N = bench.NSTATIONS
+    nu = 5.0
+
+    @jax.jit
+    def predict_fused(p):
+        jones = params_to_jones(p.reshape(M, 1, 8 * N))[:, 0]
+        tre, tim = pack_gain_tables(jones, mp)
+        m = fused_predict_packed(tre, tim, coh_ri, antp, antq, TILE, MC)
+        return jnp.sum(m)
+
+    def cost_fn(pflat):
+        jones = params_to_jones(pflat.reshape(M, 1, 8 * N))[:, 0]
+        tre, tim = pack_gain_tables(jones, mp)
+        model = fused_predict_packed(
+            tre, tim, jax.lax.stop_gradient(coh_ri), antp, antq, TILE, MC
+        )
+        d = (vis_ri - model) * maskp[:, None, :]
+        e2 = d[:, :4, :] ** 2 + d[:, 4:, :] ** 2
+        return jnp.sum(jnp.log1p(e2 / nu))
+
+    @jax.jit
+    def cost_only(p):
+        return cost_fn(p.reshape(-1))
+
+    @jax.jit
+    def cost_and_grad(p):
+        c, g = jax.value_and_grad(cost_fn)(p.reshape(-1))
+        return c + jnp.sum(g * g)
+
+    @jax.jit
+    def solve(p):
+        fit = lbfgs_fit(cost_fn, None, p.reshape(-1),
+                        itmax=bench.LBFGS_ITERS, M=7)
+        return fit.cost + fit.iterations
+
+    t_pred = _timeit(predict_fused, (p0_d,), label="fused predict fwd")
+    t_cost = _timeit(cost_only, (p0_d,), label="fused cost eval")
+    t_vg = _timeit(cost_and_grad, (p0_d,), label="fused cost+grad")
+    t_solve = _timeit(solve, (p0_d,), label="full 20-iter LBFGS (fused)")
+    print(f"\nper-iter {t_solve / bench.LBFGS_ITERS * 1e3:.2f} ms "
+          f"(XLA path measured 130.4 ms/iter)")
+    coh_bytes = coh_ri.size * 4
+    print(f"implied BW in fused fwd: {coh_bytes / t_pred / 1e9:.0f} GB/s "
+          f"of 819 GB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""On-chip phase profiling of the bench step (round-3 perf work).
+
+Times the pieces of one LBFGS iteration at the north-star shape to find
+the wall: predict forward, cost, cost+grad, and the full 20-iter solve.
+"""
+
+import time
+
+import numpy as np
+
+import bench
+
+
+def _time(fn, args, repeats=3, label=""):
+    """Time a jitted fn that returns a SCALAR.  Sync by transferring the
+    scalar to host: jax.block_until_ready is a NO-OP on the axon backend
+    (measured 0.2 ms for a 2.6 s computation), so only a host read
+    observes completion."""
+    float(np.asarray(fn(*args)))  # compile + run
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        v = float(np.asarray(fn(*args)))
+        ts.append(time.perf_counter() - t0)
+    dt = float(np.median(ts))
+    print(f"{label:34s} {dt * 1e3:9.2f} ms   (={v:.6g})")
+    return dt
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from sagecal_tpu.solvers.sage import predict_full_model
+    from sagecal_tpu.utils.platform import cpu_device
+
+    with jax.default_device(cpu_device()):
+        data, cdata, p0 = bench.build_workload(np.float32, bench.TILESZ)
+        vis_ri = np.concatenate(
+            [np.asarray(data.vis.real), np.asarray(data.vis.imag)], axis=-2
+        )
+        coh_ri = np.concatenate(
+            [np.asarray(cdata.coh.real), np.asarray(cdata.coh.imag)], axis=-2
+        )
+        mask = np.asarray(data.mask)
+        p0_h = np.asarray(p0)
+
+    dev = jax.devices()[0]
+    print("platform:", dev.platform)
+    vis_ri, mask, coh_ri, p0_d = (
+        jax.device_put(a, dev) for a in (vis_ri, mask, coh_ri, p0_h)
+    )
+    jax.block_until_ready((vis_ri, mask, coh_ri, p0_d))
+
+    M, nchunk, n8 = bench.NCLUSTERS, 1, 8 * bench.NSTATIONS
+    nu = 5.0
+
+    def unpack(vr, cr):
+        vis = jax.lax.complex(vr[:, :4, :], vr[:, 4:, :])
+        coh = jax.lax.complex(cr[:, :, :4, :], cr[:, :, 4:, :])
+        return vis, coh
+
+    @jax.jit
+    def predict_only(vr, mk, cr, p):
+        vis, coh = unpack(vr, cr)
+        d = data.replace(vis=vis, mask=mk)
+        c = cdata._replace(coh=coh)
+        m = predict_full_model(p.reshape(M, nchunk, n8), c, d)
+        return jnp.sum(jnp.real(m)) + jnp.sum(jnp.imag(m))
+
+    def make_cost(vr, mk, cr):
+        vis, coh = unpack(vr, cr)
+        d = data.replace(vis=vis, mask=mk)
+        c = cdata._replace(coh=coh)
+
+        def cost_fn(pflat):
+            model = predict_full_model(pflat.reshape(M, nchunk, n8), c, d)
+            diff = (vis - model) * mk[:, None, :]
+            e2 = jnp.real(diff) ** 2 + jnp.imag(diff) ** 2
+            return jnp.sum(jnp.log1p(e2 / nu))
+
+        return cost_fn
+
+    @jax.jit
+    def cost_only(vr, mk, cr, p):
+        return make_cost(vr, mk, cr)(p.reshape(-1))
+
+    @jax.jit
+    def cost_and_grad(vr, mk, cr, p):
+        c, g = jax.value_and_grad(make_cost(vr, mk, cr))(p.reshape(-1))
+        return c + jnp.sum(g * g)
+
+    args = (vis_ri, mask, coh_ri, p0_d)
+    t_pred = _time(predict_only, args, label="predict_full_model fwd")
+    t_cost = _time(cost_only, args, label="cost eval")
+    t_vg = _time(cost_and_grad, args, label="cost+grad (value_and_grad)")
+
+    step0 = bench.make_step(data, cdata)
+
+    @jax.jit
+    def step_scalar(vr, mk, cr, p):
+        _, cost, its = step0(vr, mk, cr, p)
+        return cost + its
+
+    t_step = _time(step_scalar, args, label=f"full {bench.LBFGS_ITERS}-iter LBFGS solve")
+    iters = bench.LBFGS_ITERS
+    print(
+        f"\nper-iter {t_step / iters * 1e3:.2f} ms; "
+        f"as cost-equivalents: step/(4*it+3) = "
+        f"{t_step / (4 * iters + 3) * 1e3:.2f} ms vs cost {t_cost * 1e3:.2f} ms"
+    )
+    coh_bytes = coh_ri.size * 4
+    print(
+        f"coh stack {coh_bytes / 1e6:.0f} MB; single-read roofline "
+        f"{coh_bytes / 819e9 * 1e3:.2f} ms @819 GB/s"
+    )
+    print(f"implied BW in predict fwd: {coh_bytes / t_pred / 1e9:.0f} GB/s")
+
+
+if __name__ == "__main__":
+    main()
