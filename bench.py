@@ -55,6 +55,10 @@ REPEATS = 3
 
 V5E_BF16_PEAK_FLOPS = 197e12  # TPU v5e per-chip peak (bf16)
 
+# Cost path selector, read ONCE so run() and the JSON record can't
+# diverge: 1 = fused Pallas RIME kernel, 0 = XLA predict path.
+FUSED = bool(int(os.environ.get("SAGECAL_BENCH_FUSED", "0")))
+
 
 from sagecal_tpu.utils.platform import (  # noqa: E402
     cpu_device as _cpu_device,
@@ -130,6 +134,57 @@ def make_step(data, cdata, nu=5.0):
     return step
 
 
+def make_fused_step(data, cdata, nu=5.0, tile=512):
+    """LBFGS step whose cost uses the fused Pallas RIME kernel
+    (ops/rime_kernel.py) instead of the XLA predict path.  Returns
+    (prep, step): ``prep`` pads rows/clusters to kernel alignment ONCE
+    (run it before the timing loop, keep results device-resident);
+    ``step`` takes the padded arrays.  Opt-in via SAGECAL_BENCH_FUSED=1
+    until validated on the chip."""
+    import jax
+    import jax.numpy as jnp
+
+    from sagecal_tpu.core.types import params_to_jones
+    from sagecal_tpu.ops.rime_kernel import (
+        fused_predict_packed, pack_gain_tables, pad_to,
+    )
+    from sagecal_tpu.solvers.lbfgs import lbfgs_fit
+
+    M, n8 = NCLUSTERS, 8 * NSTATIONS
+    mp = pad_to(M, 8)
+    rows = data.vis.shape[-1]
+    rowsp = pad_to(rows, tile)
+    antp = np.zeros((1, rowsp), np.int32)
+    antq = np.zeros((1, rowsp), np.int32)
+    antp[0, :rows] = np.asarray(data.ant_p)
+    antq[0, :rows] = np.asarray(data.ant_q)
+
+    @jax.jit
+    def prep(vis_ri, mask, coh_ri):
+        vis_p = jnp.pad(vis_ri, ((0, 0), (0, 0), (0, rowsp - rows)))
+        mask_p = jnp.pad(mask, ((0, 0), (0, rowsp - rows)))
+        coh_p = jnp.pad(coh_ri, ((0, mp - M), (0, 0), (0, 0),
+                                 (0, rowsp - rows)))
+        return vis_p, mask_p, coh_p, jnp.asarray(antp), jnp.asarray(antq)
+
+    @jax.jit
+    def step(vis_p, mask_p, coh_p, antp_d, antq_d, p0):
+        coh_c = jax.lax.stop_gradient(coh_p)
+
+        def cost_fn(pflat):
+            jones = params_to_jones(pflat.reshape(M, 1, n8))[:, 0]
+            tre, tim = pack_gain_tables(jones, mp)
+            model = fused_predict_packed(tre, tim, coh_c, antp_d, antq_d, tile)
+            d = (vis_p - model) * mask_p[:, None, :]
+            e2 = d[:, :4, :] ** 2 + d[:, 4:, :] ** 2
+            return jnp.sum(jnp.log1p(e2 / nu))
+
+        fit = lbfgs_fit(cost_fn, None, p0.reshape(-1), itmax=LBFGS_ITERS, M=7)
+        return fit.p, fit.cost, fit.iterations
+
+    return prep, step
+
+
 def analytic_flops_per_cost_eval(tilesz=TILESZ):
     """Analytic FLOPs of ONE cost evaluation (predict_full_model +
     robust cost), counting a complex multiply as 6 real FLOPs and a
@@ -176,7 +231,6 @@ def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ):
         )
         mask = np.asarray(data.mask)
         p0_h = np.asarray(p0)
-    step = make_step(data, cdata)
     # Resident inputs: numpy arguments are RE-TRANSFERRED host->device on
     # every call — measured 26 s/call for the 726 MB coherency stack
     # through the axon tunnel vs 74 ms for the whole predict once the
@@ -187,6 +241,11 @@ def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ):
     # actually drained by the untimed warm-up call + host read below,
     # which is why the timing loop never observes them.
     jax.block_until_ready(args)
+    if FUSED:
+        prep, step = make_fused_step(data, cdata)
+        args = (*prep(*args[:3]), args[3])
+    else:
+        step = make_step(data, cdata)
     xla_flops = None
     if want_flops:
         # AOT-compile once and reuse the executable for the timing loop
@@ -223,6 +282,7 @@ def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ):
 
 def _measure_cpu_subprocess(tilesz=TILESZ, timeout=1800.0):
     """Re-measure the CPU f64 baseline in a fresh process (optional)."""
+    env = {k: v for k, v in os.environ.items() if k != "SAGECAL_BENCH_FUSED"}
     code = (
         "import jax, numpy as np; jax.config.update('jax_platforms','cpu');"
         "jax.config.update('jax_enable_x64', True);"
@@ -233,7 +293,7 @@ def _measure_cpu_subprocess(tilesz=TILESZ, timeout=1800.0):
         r = subprocess.run(
             [sys.executable, "-c", code],
             timeout=timeout, capture_output=True, text=True,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
         )
         for line in r.stdout.splitlines():
             if line.startswith("CPUBASE"):
@@ -294,6 +354,7 @@ def main():
         "unit": f"iter/s (62 stn, 100 clusters, {tilesz} ts x {NCHAN} ch)",
         "vs_baseline": round(vs, 3) if vs else None,
         "platform": platform,
+        "fused_kernel": FUSED,
         "cpu_baseline_iters_per_sec": base,
         "cpu_baseline_source": "measured-live" if cpu_measured else "pinned",
         "north_star_shape": tilesz == TILESZ,

@@ -65,14 +65,14 @@ def main():
     def predict_fused(p):
         jones = params_to_jones(p.reshape(M, 1, 8 * N))[:, 0]
         tre, tim = pack_gain_tables(jones, mp)
-        m = fused_predict_packed(tre, tim, coh_ri, antp, antq, TILE, MC)
+        m = fused_predict_packed(tre, tim, coh_ri, antp, antq, TILE)
         return jnp.sum(m)
 
     def cost_fn(pflat):
         jones = params_to_jones(pflat.reshape(M, 1, 8 * N))[:, 0]
         tre, tim = pack_gain_tables(jones, mp)
         model = fused_predict_packed(
-            tre, tim, jax.lax.stop_gradient(coh_ri), antp, antq, TILE, MC
+            tre, tim, jax.lax.stop_gradient(coh_ri), antp, antq, TILE
         )
         d = (vis_ri - model) * maskp[:, None, :]
         e2 = d[:, :4, :] ** 2 + d[:, 4:, :] ** 2

@@ -11,24 +11,25 @@ contractions) materializes ~15 buffer-scale intermediates in HBM —
 measured 95 ms per forward at the north-star shape (62 stn / 100
 clusters / 60 ts x 2 ch), an effective 8 GB/s against the 726 MB
 coherency stack vs the chip's 819 GB/s.  The fused kernel streams each
-coherency block through VMEM exactly once: per (row-tile, cluster-chunk)
-grid step it
+coherency block through VMEM exactly once.
 
-1. builds the station one-hot selectors in VMEM from the tile's antenna
-   indices (re-built once per row tile),
-2. expands per-row gains with four small MXU matmuls
-   ``(4*MC, Npad) @ (Npad, T)``,
-3. evaluates the 2x2 RIME products ``Jp (C Jq^H)`` as component
-   arithmetic on ``(MC, T)`` vregs (VPU), and
-4. accumulates the cluster-reduction into the revisited output block.
+Grid design: ONE grid dimension over row tiles.  The full cluster axis
+rides inside each block — at the north-star shape a (104, 2, 8, 512)
+f32 coherency block is 3.4 MB, comfortably inside VMEM — so the forward
+writes each output block exactly once (no cross-step accumulation) and
+the kernel body is straight-line VPU/MXU code:
 
-The backward pass is a second kernel with the same structure that
-produces gain-table cotangents via the transposed one-hot matmuls
-(``dtab += dJ @ onehot^T``) — the reference's ``mderiv.cu`` role.  Both
-are wired into :func:`fused_predict_packed` with ``jax.custom_vjp``;
-gradients flow to the gain tables only (the solver never differentiates
-w.r.t. coherencies — they are per-tile constants, like the reference's
-precalculated ``coh`` array).
+1. build the station one-hot selectors from the tile's antenna indices,
+2. expand per-row gains with four MXU matmuls ``(4*Mp, NPAD) @ (NPAD, T)``,
+3. evaluate the 2x2 RIME products ``Jp (C Jq^H)`` as component
+   arithmetic on ``(Mp, T)`` vregs, reduce over clusters, store.
+
+The backward kernel has the same structure and accumulates gain-table
+cotangents across row tiles (``dtab += dJ @ onehot^T`` — the reference's
+``mderiv.cu`` role); both are wired into :func:`fused_predict_packed`
+with ``jax.custom_vjp``.  Gradients flow to the gain tables only: the
+solver never differentiates w.r.t. coherencies (per-tile constants, like
+the reference's precalculated ``coh`` array).
 
 Everything crosses the kernel boundary as REAL f32 (re/im packed on a
 leading axis): the axon TPU runtime cannot transfer complex arrays, and
@@ -36,9 +37,9 @@ packed reals keep every buffer's minor-most axis long (rows), so the
 TPU (8, 128) tiling pads nothing (core/types.py layout rationale).
 
 Layout contracts:
-  tab_re/tab_im: (M4p, Npad) gain tables, row ``4*m + comp`` with comp
-    row-major [J00, J01, J10, J11]; M4p = 4*Mp, Mp = M padded to a
-    multiple of MC, Npad = stations padded to 128.
+  tab_re/tab_im: (4*Mp, NPAD) gain tables, row ``4*m + comp`` with comp
+    row-major [J00, J01, J10, J11]; Mp = clusters padded to a multiple
+    of 8 (sublane alignment), NPAD = stations padded to 128.
   coh_ri: (Mp, F, 8, rowsp) packed coherencies, component axis
     [re XX, re XY, re YX, re YY, im XX, im XY, im YX, im YY].
   ant_p/ant_q: (1, rowsp) int32 station index per row.
@@ -56,118 +57,93 @@ from jax.experimental.pallas import tpu as pltpu
 
 NPAD = 128  # station axis padded to one MXU/VPU lane tile
 DEF_TILE = 512  # rows per grid step
-DEF_MC = 8  # clusters per grid step (sublane-aligned)
 
 
 def _use_interpret() -> bool:
     return jax.default_backend() not in ("tpu",)
 
 
-# ---------------------------------------------------------------- forward
+def _expand_gains(tabre_ref, tabim_ref, oh, mp, T):
+    """(4*Mp, NPAD) tables x (NPAD, T) one-hot -> 4 re + 4 im (Mp, T)
+    per-row gain components via MXU matmuls."""
+    g_re = jnp.dot(tabre_ref[:], oh, preferred_element_type=jnp.float32)
+    g_im = jnp.dot(tabim_ref[:], oh, preferred_element_type=jnp.float32)
+    re = [g_re.reshape(mp, 4, T)[:, k, :] for k in range(4)]
+    im = [g_im.reshape(mp, 4, T)[:, k, :] for k in range(4)]
+    return re, im
+
+
+def _rime_products(c_re, c_im, p_re, p_im, q_re, q_im):
+    """V = Jp (C Jq^H) expanded on (Mp, T) components.  Returns the 8
+    packed output planes [reXX..reYY, imXX..imYY] BEFORE the cluster
+    reduction."""
+    # A = C Jq^H: A_aj = sum_b C_ab conj(Jq_jb); 2x2 index ab = 2a+b.
+    a_re, a_im = {}, {}
+    for a in range(2):
+        for j in range(2):
+            re = im = 0.0
+            for b in range(2):
+                cr, ci = c_re[2 * a + b], c_im[2 * a + b]
+                qr, qi = q_re[2 * j + b], q_im[2 * j + b]
+                re = re + cr * qr + ci * qi
+                im = im + ci * qr - cr * qi
+            a_re[a, j], a_im[a, j] = re, im
+    # V_ij = sum_a Jp_ia A_aj.
+    v_re, v_im = [None] * 4, [None] * 4
+    for i in range(2):
+        for j in range(2):
+            re = im = 0.0
+            for a in range(2):
+                pr, pi = p_re[2 * i + a], p_im[2 * i + a]
+                ar, ai = a_re[a, j], a_im[a, j]
+                re = re + pr * ar - pi * ai
+                im = im + pr * ai + pi * ar
+            v_re[2 * i + j], v_im[2 * i + j] = re, im
+    return v_re, v_im
 
 
 def _fwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, out_ref,
-                ohp_ref, ohq_ref, *, F, MC, T):
-    c = pl.program_id(1)
+                *, F, MP, T):
+    n_iota = jax.lax.broadcasted_iota(jnp.int32, (NPAD, T), 0)
+    ohp = (n_iota == antp_ref[:]).astype(jnp.float32)
+    ohq = (n_iota == antq_ref[:]).astype(jnp.float32)
+    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T)
+    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T)
 
-    @pl.when(c == 0)
-    def _build_onehots():
-        # (Npad, T) station selectors for this row tile; padded stations
-        # (n >= N) never match an antenna index, padded rows carry
-        # arbitrary gains but zero coherencies.  Keep everything 2D —
-        # (1, T) blocks broadcast directly against the iota.
-        n_iota = jax.lax.broadcasted_iota(jnp.int32, (NPAD, T), 0)
-        ohp_ref[:] = (n_iota == antp_ref[:]).astype(jnp.float32)
-        ohq_ref[:] = (n_iota == antq_ref[:]).astype(jnp.float32)
-
-    # Gain expansion: (4*MC, Npad) @ (Npad, T) -> per-row gains (MXU).
-    gp_re = jnp.dot(tabre_ref[:], ohp_ref[:], preferred_element_type=jnp.float32)
-    gp_im = jnp.dot(tabim_ref[:], ohp_ref[:], preferred_element_type=jnp.float32)
-    gq_re = jnp.dot(tabre_ref[:], ohq_ref[:], preferred_element_type=jnp.float32)
-    gq_im = jnp.dot(tabim_ref[:], ohq_ref[:], preferred_element_type=jnp.float32)
-
-    def comp(g, k):
-        return g.reshape(MC, 4, T)[:, k, :]  # (MC, T)
-
-    p_re = [comp(gp_re, k) for k in range(4)]
-    p_im = [comp(gp_im, k) for k in range(4)]
-    q_re = [comp(gq_re, k) for k in range(4)]
-    q_im = [comp(gq_im, k) for k in range(4)]
-
-    freq_acc = []
+    planes = []
     for f in range(F):
         c_re = [coh_ref[:, f, k, :] for k in range(4)]
         c_im = [coh_ref[:, f, 4 + k, :] for k in range(4)]
-
-        # A = C Jq^H: A_aj = sum_b C_ab conj(Jq_jb); 2x2 index ab = 2a+b.
-        a_re, a_im = {}, {}
-        for a in range(2):
-            for j in range(2):
-                re = im = 0.0
-                for b in range(2):
-                    cr, ci = c_re[2 * a + b], c_im[2 * a + b]
-                    qr, qi = q_re[2 * j + b], q_im[2 * j + b]
-                    # C * conj(Q)
-                    re = re + cr * qr + ci * qi
-                    im = im + ci * qr - cr * qi
-                a_re[a, j], a_im[a, j] = re, im
-
-        # V = Jp A: V_ij = sum_a Jp_ia A_aj, reduced over the MC axis.
-        sums = [None] * 8
-        for i in range(2):
-            for j in range(2):
-                vre = vim = 0.0
-                for a in range(2):
-                    pr, pi = p_re[2 * i + a], p_im[2 * i + a]
-                    ar, ai = a_re[a, j], a_im[a, j]
-                    vre = vre + pr * ar - pi * ai
-                    vim = vim + pr * ai + pi * ar
-                k = 2 * i + j
-                sums[k] = jnp.sum(vre, axis=0, keepdims=True)  # (1, T)
-                sums[4 + k] = jnp.sum(vim, axis=0, keepdims=True)
-        freq_acc.append(jnp.concatenate(sums, axis=0))  # (8, T)
-    acc = jnp.stack(freq_acc, axis=0)  # (F, 8, T) — one full-block store
-
-    @pl.when(c == 0)
-    def _init():
-        out_ref[:] = acc
-
-    @pl.when(c != 0)
-    def _acc():
-        out_ref[:] = out_ref[:] + acc
+        v_re, v_im = _rime_products(c_re, c_im, p_re, p_im, q_re, q_im)
+        sums = [jnp.sum(v, axis=0, keepdims=True) for v in v_re + v_im]
+        planes.append(jnp.concatenate(sums, axis=0))  # (8, T)
+    out_ref[:] = jnp.stack(planes, axis=0)  # (F, 8, T)
 
 
-def _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
-                            *, tile, mc):
+def _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, *, tile):
     M4p, npad = tab_re.shape
     Mp, F, _, rowsp = coh_ri.shape
-    assert npad == NPAD and M4p == 4 * Mp
-    assert rowsp % tile == 0 and Mp % mc == 0, (rowsp, tile, Mp, mc)
-    R, C = rowsp // tile, Mp // mc
+    assert npad == NPAD and M4p == 4 * Mp and Mp % 8 == 0
+    assert rowsp % tile == 0, (rowsp, tile)
+    R = rowsp // tile
 
-    kernel = functools.partial(_fwd_kernel, F=F, MC=mc, T=tile)
+    kernel = functools.partial(_fwd_kernel, F=F, MP=Mp, T=tile)
     return pl.pallas_call(
         kernel,
-        grid=(R, C),
+        grid=(R,),
         in_specs=[
-            pl.BlockSpec((1, tile), lambda r, c: (0, r),
+            pl.BlockSpec((1, tile), lambda r: (0, r), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile), lambda r: (0, r), memory_space=pltpu.VMEM),
+            pl.BlockSpec((4 * Mp, NPAD), lambda r: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tile), lambda r, c: (0, r),
+            pl.BlockSpec((4 * Mp, NPAD), lambda r: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((4 * mc, NPAD), lambda r, c: (c, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((4 * mc, NPAD), lambda r, c: (c, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((mc, F, 8, tile), lambda r, c: (c, 0, 0, r),
+            pl.BlockSpec((Mp, F, 8, tile), lambda r: (0, 0, 0, r),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((F, 8, tile), lambda r, c: (0, 0, r),
+        out_specs=pl.BlockSpec((F, 8, tile), lambda r: (0, 0, r),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((F, 8, rowsp), jnp.float32),
-        scratch_shapes=[
-            pltpu.VMEM((NPAD, tile), jnp.float32),
-            pltpu.VMEM((NPAD, tile), jnp.float32),
-        ],
         interpret=_use_interpret(),
     )(ant_p, ant_q, tab_re, tab_im, coh_ri)
 
@@ -176,32 +152,18 @@ def _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
 
 
 def _bwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, g_ref,
-                dtabre_ref, dtabim_ref, *, F, MC, T):
-    r = pl.program_id(1)
+                dtabre_ref, dtabim_ref, *, F, MP, T):
+    r = pl.program_id(0)
+    n_iota = jax.lax.broadcasted_iota(jnp.int32, (NPAD, T), 0)
+    ohp = (n_iota == antp_ref[:]).astype(jnp.float32)
+    ohq = (n_iota == antq_ref[:]).astype(jnp.float32)
+    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T)
+    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T)
 
-    # One-hots both orientations (rebuilt per step: r varies fastest).
-    n_iota_nt = jax.lax.broadcasted_iota(jnp.int32, (NPAD, T), 0)
-    ohp = (n_iota_nt == antp_ref[:]).astype(jnp.float32)
-    ohq = (n_iota_nt == antq_ref[:]).astype(jnp.float32)
-
-    gp_re = jnp.dot(tabre_ref[:], ohp, preferred_element_type=jnp.float32)
-    gp_im = jnp.dot(tabim_ref[:], ohp, preferred_element_type=jnp.float32)
-    gq_re = jnp.dot(tabre_ref[:], ohq, preferred_element_type=jnp.float32)
-    gq_im = jnp.dot(tabim_ref[:], ohq, preferred_element_type=jnp.float32)
-
-    def comp(g, k):
-        return g.reshape(MC, 4, T)[:, k, :]
-
-    p_re = [comp(gp_re, k) for k in range(4)]
-    p_im = [comp(gp_im, k) for k in range(4)]
-    q_re = [comp(gq_re, k) for k in range(4)]
-    q_im = [comp(gq_im, k) for k in range(4)]
-
-    # Accumulate dJp / dJq on (MC, T) vregs over freq.
-    djp_re = [jnp.zeros((MC, T), jnp.float32) for _ in range(4)]
-    djp_im = [jnp.zeros((MC, T), jnp.float32) for _ in range(4)]
-    djq_re = [jnp.zeros((MC, T), jnp.float32) for _ in range(4)]
-    djq_im = [jnp.zeros((MC, T), jnp.float32) for _ in range(4)]
+    djp_re = [jnp.zeros((MP, T), jnp.float32) for _ in range(4)]
+    djp_im = [jnp.zeros((MP, T), jnp.float32) for _ in range(4)]
+    djq_re = [jnp.zeros((MP, T), jnp.float32) for _ in range(4)]
+    djq_im = [jnp.zeros((MP, T), jnp.float32) for _ in range(4)]
 
     for f in range(F):
         c_re = [coh_ref[:, f, k, :] for k in range(4)]
@@ -255,11 +217,11 @@ def _bwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, g_ref,
                 djq_re[2 * j + b] = djq_re[2 * j + b] + re
                 djq_im[2 * j + b] = djq_im[2 * j + b] + im
 
-    # Scatter to stations: dtab[m4, n] += dJ (MC4, T) @ onehot^T (T, Npad).
-    djp_re_m = jnp.stack(djp_re, axis=1).reshape(4 * MC, T)
-    djp_im_m = jnp.stack(djp_im, axis=1).reshape(4 * MC, T)
-    djq_re_m = jnp.stack(djq_re, axis=1).reshape(4 * MC, T)
-    djq_im_m = jnp.stack(djq_im, axis=1).reshape(4 * MC, T)
+    # Scatter to stations: dtab[m4, n] += dJ (4*Mp, T) @ onehot^T (T, NPAD).
+    djp_re_m = jnp.stack(djp_re, axis=1).reshape(4 * MP, T)
+    djp_im_m = jnp.stack(djp_im, axis=1).reshape(4 * MP, T)
+    djq_re_m = jnp.stack(djq_re, axis=1).reshape(4 * MP, T)
+    djq_im_m = jnp.stack(djq_im, axis=1).reshape(4 * MP, T)
     dre = (jnp.dot(djp_re_m, ohp.T, preferred_element_type=jnp.float32)
            + jnp.dot(djq_re_m, ohq.T, preferred_element_type=jnp.float32))
     dim = (jnp.dot(djp_im_m, ohp.T, preferred_element_type=jnp.float32)
@@ -277,33 +239,31 @@ def _bwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, g_ref,
 
 
 def _fused_predict_bwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, g_ri,
-                            *, tile, mc):
-    M4p, npad = tab_re.shape
+                            *, tile):
+    M4p, _ = tab_re.shape
     Mp, F, _, rowsp = coh_ri.shape
-    R, C = rowsp // tile, Mp // mc
+    R = rowsp // tile
 
-    kernel = functools.partial(_bwd_kernel, F=F, MC=mc, T=tile)
+    kernel = functools.partial(_bwd_kernel, F=F, MP=Mp, T=tile)
     return pl.pallas_call(
         kernel,
-        grid=(C, R),
+        grid=(R,),
         in_specs=[
-            pl.BlockSpec((1, tile), lambda c, r: (0, r),
+            pl.BlockSpec((1, tile), lambda r: (0, r), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile), lambda r: (0, r), memory_space=pltpu.VMEM),
+            pl.BlockSpec((4 * Mp, NPAD), lambda r: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tile), lambda c, r: (0, r),
+            pl.BlockSpec((4 * Mp, NPAD), lambda r: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((4 * mc, NPAD), lambda c, r: (c, 0),
+            pl.BlockSpec((Mp, F, 8, tile), lambda r: (0, 0, 0, r),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((4 * mc, NPAD), lambda c, r: (c, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((mc, F, 8, tile), lambda c, r: (c, 0, 0, r),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((F, 8, tile), lambda c, r: (0, 0, r),
+            pl.BlockSpec((F, 8, tile), lambda r: (0, 0, r),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((4 * mc, NPAD), lambda c, r: (c, 0),
+            pl.BlockSpec((4 * Mp, NPAD), lambda r: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((4 * mc, NPAD), lambda c, r: (c, 0),
+            pl.BlockSpec((4 * Mp, NPAD), lambda r: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
@@ -317,28 +277,28 @@ def _fused_predict_bwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, g_ri,
 # ------------------------------------------------------------ public API
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
 def fused_predict_packed(tab_re, tab_im, coh_ri, ant_p, ant_q,
-                         tile=DEF_TILE, mc=DEF_MC):
+                         tile=DEF_TILE):
     """Full-model RIME predict, packed-real layout (module docstring).
 
     Differentiable w.r.t. ``tab_re``/``tab_im`` only — coherencies are
     per-tile constants in every solver path (wrap in
     ``jax.lax.stop_gradient`` at call sites for clarity)."""
     return _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
-                                   tile=tile, mc=mc)
+                                   tile=tile)
 
 
-def _vjp_fwd(tab_re, tab_im, coh_ri, ant_p, ant_q, tile, mc):
+def _vjp_fwd(tab_re, tab_im, coh_ri, ant_p, ant_q, tile):
     out = _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
-                                  tile=tile, mc=mc)
+                                  tile=tile)
     return out, (tab_re, tab_im, coh_ri, ant_p, ant_q)
 
 
-def _vjp_bwd(tile, mc, res, g_ri):
+def _vjp_bwd(tile, res, g_ri):
     tab_re, tab_im, coh_ri, ant_p, ant_q = res
     dre, dim = _fused_predict_bwd_impl(
-        tab_re, tab_im, coh_ri, ant_p, ant_q, g_ri, tile=tile, mc=mc
+        tab_re, tab_im, coh_ri, ant_p, ant_q, g_ri, tile=tile
     )
     return dre, dim, None, None, None
 

@@ -239,19 +239,23 @@ def predict_full_model(p_all, cdata: ClusterData, data: VisData):
     c10 = cdata.coh[:, :, 2, :]
     c11 = cdata.coh[:, :, 3, :]
 
-    def contract(coef, c):
+    def contract(coef, w):
         # (M, rows) x (M, F, rows) -> (F, rows), reduced over clusters
-        return jnp.einsum("kr,kfr->fr", coef, c)
+        return jnp.einsum("kr,kfr->fr", coef, w)
 
-    # V = J_p C J_q^H expanded: V_ij = sum_ab Jp[i,a] C[a,b] conj(Jq[j,b])
-    v00 = (contract(pa * qa, c00) + contract(pb * qa, c10)
-           + contract(pa * qb, c01) + contract(pb * qb, c11))
-    v01 = (contract(pa * qc, c00) + contract(pb * qc, c10)
-           + contract(pa * qd, c01) + contract(pb * qd, c11))
-    v10 = (contract(pc * qa, c00) + contract(pd * qa, c10)
-           + contract(pc * qb, c01) + contract(pd * qb, c11))
-    v11 = (contract(pc * qc, c00) + contract(pd * qc, c10)
-           + contract(pc * qd, c01) + contract(pd * qd, c11))
+    # V = J_p (C J_q^H) factored in two stages: W_aj = sum_b C_ab qconj_jb
+    # reads the coherency stack ONCE (the 16-term single-stage expansion
+    # re-read each C component four times — ~2x the HBM traffic of this
+    # form, measured on chip), then V_ij = sum_ma Jp_ia W_aj.
+    q = lambda g: g[:, None, :]  # (M, rows) -> (M, 1, rows) vs (M, F, rows)
+    w00 = c00 * q(qa) + c01 * q(qb)
+    w01 = c00 * q(qc) + c01 * q(qd)
+    w10 = c10 * q(qa) + c11 * q(qb)
+    w11 = c10 * q(qc) + c11 * q(qd)
+    v00 = contract(pa, w00) + contract(pb, w10)
+    v01 = contract(pa, w01) + contract(pb, w11)
+    v10 = contract(pc, w00) + contract(pd, w10)
+    v11 = contract(pc, w01) + contract(pd, w11)
     return jnp.stack([v00, v01, v10, v11], axis=-2)
 
 

@@ -19,7 +19,7 @@ from sagecal_tpu.ops.rime_kernel import (
     unpack_gain_grads,
 )
 
-TILE, MC = 128, 2
+TILE, MC = 128, 8  # cluster axis padded to a multiple of 8 (sublanes)
 
 
 def _random_problem(seed=0, M=3, N=6, F=2, rows=200):
@@ -59,7 +59,7 @@ def test_forward_matches_oracle():
     tab_re, tab_im = pack_gain_tables(jnp.asarray(jones), mp)
     out = fused_predict_packed(
         tab_re, tab_im, jnp.asarray(coh_ri), jnp.asarray(antp),
-        jnp.asarray(antq), TILE, MC,
+        jnp.asarray(antq), TILE,
     )
     out = np.asarray(out)
     rows = coh.shape[-1]
@@ -82,7 +82,7 @@ def test_gradients_match_autodiff_oracle():
 
     def loss_kernel(tab_re, tab_im):
         m = fused_predict_packed(tab_re, tab_im, coh_j, antp_j, antq_j,
-                                 TILE, MC)
+                                 TILE)
         return jnp.sum(w * m) + jnp.sum(jnp.cos(m) * w)
 
     def loss_xla(tab_re, tab_im):
@@ -120,7 +120,7 @@ def test_forward_multi_freq_shapes(F):
     tab_re, tab_im = pack_gain_tables(jnp.asarray(jones), mp)
     out = fused_predict_packed(
         tab_re, tab_im, jnp.asarray(coh_ri), jnp.asarray(antp),
-        jnp.asarray(antq), TILE, MC,
+        jnp.asarray(antq), TILE,
     )
     assert out.shape == (F, 8, rowsp)
     want = _oracle_model(jones, coh, ant_p, ant_q)
