@@ -134,7 +134,7 @@ def make_step(data, cdata, nu=5.0):
     return step
 
 
-def make_fused_step(data, cdata, nu=5.0, tile=512):
+def make_fused_step(data, nu=5.0, tile=512):
     """LBFGS step whose cost uses the fused Pallas RIME kernel
     (ops/rime_kernel.py) instead of the XLA predict path.  Returns
     (prep, step): ``prep`` pads rows/clusters to kernel alignment ONCE
@@ -242,7 +242,7 @@ def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ):
     # which is why the timing loop never observes them.
     jax.block_until_ready(args)
     if FUSED:
-        prep, step = make_fused_step(data, cdata)
+        prep, step = make_fused_step(data)
         args = (*prep(*args[:3]), args[3])
     else:
         step = make_step(data, cdata)

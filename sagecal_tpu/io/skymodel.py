@@ -139,7 +139,10 @@ def parse_clusters(path: str) -> list:
             cid = int(tok[0])
             out.append(
                 ClusterDef(
-                    cluster_id=abs(cid),
+                    # keep the RAW signed id: -G / -z / -E files refer to
+                    # clusters by the signed id as written (readsky.c);
+                    # the no-subtract semantics live in ``subtract``
+                    cluster_id=cid,
                     nchunk=max(1, int(tok[1])),
                     source_names=tok[2:],
                     subtract=cid >= 0,
