@@ -7,6 +7,9 @@ per-process addressable shards, the z-step psum and manifold all_gather
 cross the process boundary through the gloo CPU collectives — the DCN
 layer of SURVEY §5's mapping, with `jax.distributed` standing in for
 the reference's MPI world (sagecal_master.cpp).
+
+The workload is defined ONCE in mh_common.py (shared with the
+single-process comparison run in the parent test).
 """
 import os
 import sys
@@ -28,56 +31,14 @@ jax.distributed.initialize(f"localhost:{port}", num_processes=2,
                            process_id=pid)
 
 import numpy as np  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
 
-from sagecal_tpu.core.types import jones_to_params  # noqa: E402
-from sagecal_tpu.io.simulate import (  # noqa: E402
-    corrupt_and_observe, make_visdata, random_jones,
-)
-from sagecal_tpu.ops.rime import point_source_batch  # noqa: E402
-from sagecal_tpu.parallel import consensus  # noqa: E402
-from sagecal_tpu.parallel.mesh import make_admm_mesh_fn, stack_for_mesh  # noqa: E402
+import mh_common  # noqa: E402
+from sagecal_tpu.parallel.mesh import make_admm_mesh_fn  # noqa: E402
 from sagecal_tpu.solvers.lm import LMConfig  # noqa: E402
-from sagecal_tpu.solvers.sage import build_cluster_data  # noqa: E402
 
-Nf, M, N, f0, Npoly = 8, 2, 6, 150e6, 2
-freqs = np.linspace(130e6, 170e6, Nf)
-
-rng = np.random.default_rng(7)
-Z0 = np.asarray(random_jones(M, N, seed=1, amp=0.15, dtype=np.complex128))
-Z1 = 0.05 * (rng.standard_normal((M, N, 2, 2))
-             + 1j * rng.standard_normal((M, N, 2, 2)))
-
-clusters = [
-    point_source_batch([0.01], [0.02], [2.0], f0=f0, dtype=jnp.float64),
-    point_source_batch([-0.02], [0.01], [1.5], f0=f0, dtype=jnp.float64),
-]
-
-bands = []
-for f in range(Nf):
-    frat = (freqs[f] - f0) / f0
-    jones_f = jnp.asarray(Z0 + frat * Z1)
-    data = make_visdata(nstations=N, tilesz=2, nchan=1, freq0=f0,
-                        dtype=np.float64, seed=f)
-    data = corrupt_and_observe(data, clusters, jones=jones_f,
-                               noise_sigma=1e-4, seed=f)
-    data = data.replace(freqs=jnp.asarray([freqs[f]], jnp.float64))
-    cdata = build_cluster_data(data, clusters, [1] * M)
-    bands.append((data, cdata))
-
-data_stack = stack_for_mesh([b[0] for b in bands])
-cdata_stack = stack_for_mesh([b[1] for b in bands])
-p0 = jnp.stack(
-    [jones_to_params(
-        random_jones(M, N, seed=500, amp=0.0, dtype=np.complex128)
-    )[:, None, :] for _ in range(Nf)]
-)
-rho = jnp.full((Nf, M), 20.0, jnp.float64)
-B = jnp.asarray(
-    consensus.setup_polynomials(freqs, f0, Npoly, consensus.POLY_ORDINARY)
-)
-
+data_stack, cdata_stack, p0, rho, B = mh_common.build_workload()
+Nf = mh_common.Nf
 mesh = Mesh(np.array(jax.devices()).reshape(Nf), ("freq",))
 
 
@@ -95,8 +56,9 @@ data_g = jax.tree.map(globalize, data_stack)
 cdata_g = jax.tree.map(globalize, cdata_stack)
 p0_g, rho_g, B_g = (globalize(x) for x in (p0, rho, B))
 
-fn = make_admm_mesh_fn(mesh, nadmm=4, max_emiter=1, plain_emiter=1,
-                       lm_config=LMConfig(itmax=6), bb_rho=False)
+fn = make_admm_mesh_fn(mesh, nadmm=mh_common.NADMM, max_emiter=1,
+                       plain_emiter=1, lm_config=LMConfig(itmax=6),
+                       bb_rho=False)
 out = fn(data_g, cdata_g, p0_g, rho_g, B_g)
 
 dual = np.asarray(jax.device_get(out.dual_res.addressable_shards[0].data)).ravel()

@@ -1,0 +1,55 @@
+"""Shared workload for the multi-host test pair (mh_child.py runs it in
+each of two OS processes; test_multihost.py runs it single-process) —
+one definition so the process-count-invariance comparison can't drift."""
+
+import numpy as np
+
+Nf, M, N, F0, NPOLY = 8, 2, 6, 150e6, 2
+FREQS = np.linspace(130e6, 170e6, Nf)
+NADMM = 4
+
+
+def build_workload():
+    """Returns (data_stack, cdata_stack, p0, rho, B) host-local arrays
+    with leading sub-band axis Nf.  Deterministic: identical in every
+    process."""
+    import jax.numpy as jnp
+
+    from sagecal_tpu.core.types import jones_to_params
+    from sagecal_tpu.io.simulate import (
+        corrupt_and_observe, make_visdata, random_jones,
+    )
+    from sagecal_tpu.ops.rime import point_source_batch
+    from sagecal_tpu.parallel import consensus
+    from sagecal_tpu.parallel.mesh import stack_for_mesh
+    from sagecal_tpu.solvers.sage import build_cluster_data
+
+    rng = np.random.default_rng(7)
+    Z0 = np.asarray(random_jones(M, N, seed=1, amp=0.15, dtype=np.complex128))
+    Z1 = 0.05 * (rng.standard_normal((M, N, 2, 2))
+                 + 1j * rng.standard_normal((M, N, 2, 2)))
+    clusters = [
+        point_source_batch([0.01], [0.02], [2.0], f0=F0, dtype=jnp.float64),
+        point_source_batch([-0.02], [0.01], [1.5], f0=F0, dtype=jnp.float64),
+    ]
+    bands = []
+    for f in range(Nf):
+        frat = (FREQS[f] - F0) / F0
+        jones_f = jnp.asarray(Z0 + frat * Z1)
+        data = make_visdata(nstations=N, tilesz=2, nchan=1, freq0=F0,
+                            dtype=np.float64, seed=f)
+        data = corrupt_and_observe(data, clusters, jones=jones_f,
+                                   noise_sigma=1e-4, seed=f)
+        data = data.replace(freqs=jnp.asarray([FREQS[f]], jnp.float64))
+        bands.append((data, build_cluster_data(data, clusters, [1] * M)))
+    p0 = jnp.stack(
+        [jones_to_params(
+            random_jones(M, N, seed=500, amp=0.0, dtype=np.complex128)
+        )[:, None, :] for _ in range(Nf)]
+    )
+    rho = jnp.full((Nf, M), 20.0, jnp.float64)
+    B = jnp.asarray(
+        consensus.setup_polynomials(FREQS, F0, NPOLY, consensus.POLY_ORDINARY)
+    )
+    return (stack_for_mesh([b[0] for b in bands]),
+            stack_for_mesh([b[1] for b in bands]), p0, rho, B)

@@ -120,6 +120,40 @@ def load_lib():
     return lib
 
 
+_COST_CB = ctypes.CFUNCTYPE(ctypes.c_double, _PD, ctypes.c_int,
+                            ctypes.c_void_p)
+_GRAD_CB = ctypes.CFUNCTYPE(None, _PD, _PD, ctypes.c_int, ctypes.c_void_p)
+
+
+def ref_lbfgs_fit(cost, grad, p0, itmax=100, mem=7):
+    """The reference's generic cost/grad-callback optimizer contract
+    (``lbfgs_fit``, Dirac.h:175; demo oracle test/Dirac/demo.c):
+    ``cost(p)->float`` and ``grad(p)->array`` are Python callables."""
+    lib = load_lib()
+    assert lib is not None
+    m = len(p0)
+    # copy=True: the C solver writes the solution into this buffer; a
+    # no-copy pass-through would mutate the CALLER's p0 in place
+    p = np.array(p0, np.float64, copy=True)
+
+    @_COST_CB
+    def c_cost(pp, mm, adata):
+        arr = np.ctypeslib.as_array(pp, shape=(mm,))
+        return float(cost(arr))
+
+    @_GRAD_CB
+    def c_grad(pp, gg, mm, adata):
+        arr = np.ctypeslib.as_array(pp, shape=(mm,))
+        g = np.ctypeslib.as_array(gg, shape=(mm,))
+        g[:] = np.asarray(grad(arr), np.float64)
+
+    lib.lbfgs_fit.restype = ctypes.c_int
+    rv = lib.lbfgs_fit(c_cost, c_grad, p.ctypes.data_as(_PD),
+                       ctypes.c_int(m), ctypes.c_int(itmax),
+                       ctypes.c_int(mem), None, None)
+    return p, rv
+
+
 def ref_sagefit(
     u, v, w, x, nstations, nbase, tilesz, sta1, sta2, coh, m,
     p0, *, freq0=150e6, fdelta=180e3, uvmin=0.0, nthreads=2,

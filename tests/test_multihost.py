@@ -7,9 +7,9 @@ boundary — the mechanism that rides DCN on a real multi-host TPU pod
 (SURVEY §5 mapping; the reference's MPI world, sagecal_master.cpp).
 
 Asserts (a) both ranks produce identical ADMM traces, and (b) the
-multi-process run matches the SAME workload executed single-process on
-the parent's 8 virtual devices — process-count invariance of the whole
-mesh program.
+multi-process run matches the SAME workload (tests/mh_common.py)
+executed single-process on the parent's 8 virtual devices —
+process-count invariance of the whole mesh program.
 """
 
 import os
@@ -43,7 +43,7 @@ def _parse_trace(line):
 def test_two_process_mesh_admm_matches_single_process():
     port = _free_port()
     env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(HERE)
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep + HERE
     # children configure their own platform/devices before importing jax
     env.pop("JAX_PLATFORMS", None)
     procs = [
@@ -56,10 +56,17 @@ def test_two_process_mesh_admm_matches_single_process():
         for pid in range(2)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=540)
-        outs.append(out)
-        assert p.returncode == 0, out[-2000:]
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+            assert p.returncode == 0, out[-2000:]
+    finally:
+        # never leave a rank blocked in a gloo collective (its
+        # xla collective timeout is hours)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     traces = {}
     for out in outs:
         for line in out.splitlines():
@@ -78,53 +85,19 @@ def test_two_process_mesh_admm_matches_single_process():
     # (b) process-count invariance: same workload, single process,
     # 8 virtual devices (the parent's conftest environment)
     import jax
-    import jax.numpy as jnp
     from jax.sharding import Mesh
 
-    from sagecal_tpu.core.types import jones_to_params
-    from sagecal_tpu.io.simulate import (
-        corrupt_and_observe, make_visdata, random_jones,
-    )
-    from sagecal_tpu.ops.rime import point_source_batch
-    from sagecal_tpu.parallel import consensus
-    from sagecal_tpu.parallel.mesh import make_admm_mesh_fn, stack_for_mesh
+    sys.path.insert(0, HERE)
+    import mh_common
+    from sagecal_tpu.parallel.mesh import make_admm_mesh_fn
     from sagecal_tpu.solvers.lm import LMConfig
-    from sagecal_tpu.solvers.sage import build_cluster_data
 
-    Nf, M, N, f0, Npoly = 8, 2, 6, 150e6, 2
-    freqs = np.linspace(130e6, 170e6, Nf)
-    rng = np.random.default_rng(7)
-    Z0 = np.asarray(random_jones(M, N, seed=1, amp=0.15, dtype=np.complex128))
-    Z1 = 0.05 * (rng.standard_normal((M, N, 2, 2))
-                 + 1j * rng.standard_normal((M, N, 2, 2)))
-    clusters = [
-        point_source_batch([0.01], [0.02], [2.0], f0=f0, dtype=jnp.float64),
-        point_source_batch([-0.02], [0.01], [1.5], f0=f0, dtype=jnp.float64),
-    ]
-    bands = []
-    for f in range(Nf):
-        frat = (freqs[f] - f0) / f0
-        jones_f = jnp.asarray(Z0 + frat * Z1)
-        data = make_visdata(nstations=N, tilesz=2, nchan=1, freq0=f0,
-                            dtype=np.float64, seed=f)
-        data = corrupt_and_observe(data, clusters, jones=jones_f,
-                                   noise_sigma=1e-4, seed=f)
-        data = data.replace(freqs=jnp.asarray([freqs[f]], jnp.float64))
-        bands.append((data, build_cluster_data(data, clusters, [1] * M)))
-    p0 = jnp.stack(
-        [jones_to_params(
-            random_jones(M, N, seed=500, amp=0.0, dtype=np.complex128)
-        )[:, None, :] for _ in range(Nf)]
-    )
-    rho = jnp.full((Nf, M), 20.0, jnp.float64)
-    B = jnp.asarray(
-        consensus.setup_polynomials(freqs, f0, Npoly, consensus.POLY_ORDINARY)
-    )
-    mesh = Mesh(np.array(jax.devices()[:8]).reshape(Nf), ("freq",))
-    fn = make_admm_mesh_fn(mesh, nadmm=4, max_emiter=1, plain_emiter=1,
-                           lm_config=LMConfig(itmax=6), bb_rho=False)
-    out = fn(stack_for_mesh([b[0] for b in bands]),
-             stack_for_mesh([b[1] for b in bands]), p0, rho, B)
+    data_stack, cdata_stack, p0, rho, B = mh_common.build_workload()
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(mh_common.Nf), ("freq",))
+    fn = make_admm_mesh_fn(mesh, nadmm=mh_common.NADMM, max_emiter=1,
+                           plain_emiter=1, lm_config=LMConfig(itmax=6),
+                           bb_rho=False)
+    out = fn(data_stack, cdata_stack, p0, rho, B)
     np.testing.assert_allclose(np.asarray(out.dual_res).ravel(),
                                traces[0][0], rtol=1e-8)
     np.testing.assert_allclose(np.asarray(out.primal_res).ravel(),

@@ -128,6 +128,46 @@ def test_layout_contract_truth_residual_zero():
     assert res_0 < 1e-14, f"layout mismatch: truth residual {res_0}"
 
 
+def test_generic_optimizer_contract_rosenbrock():
+    """SURVEY §3.5 library-only contract: the reference's lbfgs_fit and
+    ours both minimize the 400-dim Rosenbrock chain (the demo oracle,
+    test/Dirac/demo.c) from the same start to the known minimum 1..1."""
+    import jax
+
+    n = 400
+
+    def cost_np(p):
+        return float(np.sum(100.0 * (p[1::2] - p[0::2] ** 2) ** 2
+                            + (1.0 - p[0::2]) ** 2))
+
+    def grad_np(p):
+        g = np.zeros_like(p)
+        a, b = p[0::2], p[1::2]
+        g[1::2] = 200.0 * (b - a * a)
+        g[0::2] = -400.0 * a * (b - a * a) - 2.0 * (1.0 - a)
+        return g
+
+    p0 = np.full(n, -1.2)
+    p0[1::2] = 1.0
+    p_ref, rv = ref_oracle.ref_lbfgs_fit(cost_np, grad_np, p0, itmax=2000,
+                                         mem=11)
+    assert cost_np(p_ref) < 1e-8, cost_np(p_ref)
+
+    from sagecal_tpu.solvers.lbfgs import lbfgs_fit
+
+    def cost_jax(p):
+        return jnp.sum(100.0 * (p[1::2] - p[0::2] ** 2) ** 2
+                       + (1.0 - p[0::2]) ** 2)
+
+    fit = jax.jit(
+        lambda p: lbfgs_fit(cost_jax, None, p, itmax=2000, M=11).p
+    )(jnp.asarray(p0))
+    ours = np.asarray(fit)
+    assert cost_np(ours) < 1e-8, cost_np(ours)
+    np.testing.assert_allclose(ours, p_ref, atol=1e-4)
+    np.testing.assert_allclose(ours, 1.0, atol=1e-4)
+
+
 @pytest.mark.slow
 def test_anchor_single_cluster_lm_1e6():
     """Single-cluster LM+LBFGS: both reach the optimum to machine
