@@ -63,14 +63,50 @@ def _use_interpret() -> bool:
     return jax.default_backend() not in ("tpu",)
 
 
-def _expand_gains(tabre_ref, tabim_ref, oh, mp, T):
-    """(4*Mp, NPAD) tables x (NPAD, T) one-hot -> 4 re + 4 im (Mp, T)
-    per-row gain components via MXU matmuls."""
+def _expand_gains(tabre_ref, tabim_ref, oh, mp, T, nc=1, cmap=None):
+    """(4*Mp*nc, NPAD) tables x (NPAD, T) one-hot -> 4 re + 4 im
+    (Mp, T) per-row gain components via MXU matmuls.
+
+    ``nc > 1`` is the reference's hybrid time-chunk mode (one solution
+    per chunk of the tile, lmfit.c:86-87): the tables carry one row
+    block per (cluster, chunk) and ``cmap`` (Mp, T) selects each row's
+    chunk — a static unrolled select over the (small) chunk count."""
     g_re = jnp.dot(tabre_ref[:], oh, preferred_element_type=jnp.float32)
     g_im = jnp.dot(tabim_ref[:], oh, preferred_element_type=jnp.float32)
-    re = [g_re.reshape(mp, 4, T)[:, k, :] for k in range(4)]
-    im = [g_im.reshape(mp, 4, T)[:, k, :] for k in range(4)]
+    if nc == 1:
+        re = [g_re.reshape(mp, 4, T)[:, k, :] for k in range(4)]
+        im = [g_im.reshape(mp, 4, T)[:, k, :] for k in range(4)]
+        return re, im
+    gr = g_re.reshape(mp, nc, 4, T)
+    gi = g_im.reshape(mp, nc, 4, T)
+    sels = [(cmap == c).astype(jnp.float32) for c in range(nc)]  # (Mp, T)
+    re, im = [], []
+    for k in range(4):
+        acc_r = acc_i = 0.0
+        for c in range(nc):
+            acc_r = acc_r + sels[c] * gr[:, c, k, :]
+            acc_i = acc_i + sels[c] * gi[:, c, k, :]
+        re.append(acc_r)
+        im.append(acc_i)
     return re, im
+
+
+def _scatter_gain_grads(dj_re, dj_im, mp, T, nc, cmap):
+    """Inverse of the hybrid chunk select: route per-row gain
+    cotangents (4 x (Mp, T)) back to their (cluster, chunk) table rows
+    -> (4*Mp*nc, T) pair."""
+    if nc == 1:
+        dre = jnp.stack(dj_re, axis=1).reshape(4 * mp, T)
+        dim = jnp.stack(dj_im, axis=1).reshape(4 * mp, T)
+        return dre, dim
+    rows_r, rows_i = [], []
+    for c in range(nc):
+        sel = (cmap == c).astype(jnp.float32)
+        rows_r.append(jnp.stack([sel * d for d in dj_re], axis=1))
+        rows_i.append(jnp.stack([sel * d for d in dj_im], axis=1))
+    dre = jnp.stack(rows_r, axis=1).reshape(4 * mp * nc, T)
+    dim = jnp.stack(rows_i, axis=1).reshape(4 * mp * nc, T)
+    return dre, dim
 
 
 def _rime_products(c_re, c_im, p_re, p_im, q_re, q_im):
@@ -102,14 +138,14 @@ def _rime_products(c_re, c_im, p_re, p_im, q_re, q_im):
     return v_re, v_im
 
 
-def _fwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, out_ref,
-                *, F, MP, T):
+def _onehots(antp_ref, antq_ref, T):
     n_iota = jax.lax.broadcasted_iota(jnp.int32, (NPAD, T), 0)
     ohp = (n_iota == antp_ref[:]).astype(jnp.float32)
     ohq = (n_iota == antq_ref[:]).astype(jnp.float32)
-    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T)
-    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T)
+    return ohp, ohq
 
+
+def _fwd_store(coh_ref, out_ref, p_re, p_im, q_re, q_im, F):
     planes = []
     for f in range(F):
         c_re = [coh_ref[:, f, k, :] for k in range(4)]
@@ -120,46 +156,82 @@ def _fwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, out_ref,
     out_ref[:] = jnp.stack(planes, axis=0)  # (F, 8, T)
 
 
-def _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, *, tile):
+def _fwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, out_ref,
+                *, F, MP, T):
+    ohp, ohq = _onehots(antp_ref, antq_ref, T)
+    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T)
+    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T)
+    _fwd_store(coh_ref, out_ref, p_re, p_im, q_re, q_im, F)
+
+
+def _fwd_kernel_hybrid(antp_ref, antq_ref, cmap_ref, tabre_ref, tabim_ref,
+                       coh_ref, out_ref, *, F, MP, T, NC):
+    ohp, ohq = _onehots(antp_ref, antq_ref, T)
+    cmap = cmap_ref[:]
+    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T, NC, cmap)
+    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T, NC, cmap)
+    _fwd_store(coh_ref, out_ref, p_re, p_im, q_re, q_im, F)
+
+
+def _shape_args(tab_re, coh_ri, tile, nc):
     M4p, npad = tab_re.shape
     Mp, F, _, rowsp = coh_ri.shape
-    assert npad == NPAD and M4p == 4 * Mp and Mp % 8 == 0
+    assert npad == NPAD and M4p == 4 * Mp * nc and Mp % 8 == 0
     assert rowsp % tile == 0, (rowsp, tile)
-    R = rowsp // tile
+    return Mp, F, rowsp, rowsp // tile
 
-    kernel = functools.partial(_fwd_kernel, F=F, MP=Mp, T=tile)
+
+def _row_spec(tile):
+    return pl.BlockSpec((1, tile), lambda r: (0, r), memory_space=pltpu.VMEM)
+
+
+def _tab_spec(nrows):
+    return pl.BlockSpec((nrows, NPAD), lambda r: (0, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _coh_spec(Mp, F, tile):
+    return pl.BlockSpec((Mp, F, 8, tile), lambda r: (0, 0, 0, r),
+                        memory_space=pltpu.VMEM)
+
+
+def _cmap_spec(Mp, tile):
+    return pl.BlockSpec((Mp, tile), lambda r: (0, r),
+                        memory_space=pltpu.VMEM)
+
+
+def _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, *, tile,
+                            nc=1, cmap=None):
+    Mp, F, rowsp, R = _shape_args(tab_re, coh_ri, tile, nc)
+    if nc == 1:
+        kernel = functools.partial(_fwd_kernel, F=F, MP=Mp, T=tile)
+        specs = [_row_spec(tile), _row_spec(tile),
+                 _tab_spec(4 * Mp), _tab_spec(4 * Mp), _coh_spec(Mp, F, tile)]
+        args = (ant_p, ant_q, tab_re, tab_im, coh_ri)
+    else:
+        kernel = functools.partial(_fwd_kernel_hybrid, F=F, MP=Mp, T=tile,
+                                   NC=nc)
+        specs = [_row_spec(tile), _row_spec(tile), _cmap_spec(Mp, tile),
+                 _tab_spec(4 * Mp * nc), _tab_spec(4 * Mp * nc),
+                 _coh_spec(Mp, F, tile)]
+        args = (ant_p, ant_q, cmap, tab_re, tab_im, coh_ri)
     return pl.pallas_call(
         kernel,
         grid=(R,),
-        in_specs=[
-            pl.BlockSpec((1, tile), lambda r: (0, r), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tile), lambda r: (0, r), memory_space=pltpu.VMEM),
-            pl.BlockSpec((4 * Mp, NPAD), lambda r: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((4 * Mp, NPAD), lambda r: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((Mp, F, 8, tile), lambda r: (0, 0, 0, r),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=specs,
         out_specs=pl.BlockSpec((F, 8, tile), lambda r: (0, 0, r),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((F, 8, rowsp), jnp.float32),
         interpret=_use_interpret(),
-    )(ant_p, ant_q, tab_re, tab_im, coh_ri)
+    )(*args)
 
 
 # ---------------------------------------------------------------- backward
 
 
-def _bwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, g_ref,
-                dtabre_ref, dtabim_ref, *, F, MP, T):
-    r = pl.program_id(0)
-    n_iota = jax.lax.broadcasted_iota(jnp.int32, (NPAD, T), 0)
-    ohp = (n_iota == antp_ref[:]).astype(jnp.float32)
-    ohq = (n_iota == antq_ref[:]).astype(jnp.float32)
-    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T)
-    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T)
-
+def _bwd_accumulate(coh_ref, g_ref, p_re, p_im, q_re, q_im, F, MP, T):
+    """Per-row gain cotangents dJp/dJq (4 x (MP, T) re/im each),
+    accumulated over freq from the upstream model cotangent g."""
     djp_re = [jnp.zeros((MP, T), jnp.float32) for _ in range(4)]
     djp_im = [jnp.zeros((MP, T), jnp.float32) for _ in range(4)]
     djq_re = [jnp.zeros((MP, T), jnp.float32) for _ in range(4)]
@@ -217,11 +289,17 @@ def _bwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, g_ref,
                 djq_re[2 * j + b] = djq_re[2 * j + b] + re
                 djq_im[2 * j + b] = djq_im[2 * j + b] + im
 
-    # Scatter to stations: dtab[m4, n] += dJ (4*Mp, T) @ onehot^T (T, NPAD).
-    djp_re_m = jnp.stack(djp_re, axis=1).reshape(4 * MP, T)
-    djp_im_m = jnp.stack(djp_im, axis=1).reshape(4 * MP, T)
-    djq_re_m = jnp.stack(djq_re, axis=1).reshape(4 * MP, T)
-    djq_im_m = jnp.stack(djq_im, axis=1).reshape(4 * MP, T)
+    return (djp_re, djp_im), (djq_re, djq_im)
+
+
+def _bwd_store(dtabre_ref, dtabim_ref, djp, djq, ohp, ohq, MP, T, nc=1,
+               cmap=None):
+    """Scatter per-row gain cotangents to table rows:
+    dtab[m4, n] += dJ (4*Mp*nc, T) @ onehot^T (T, NPAD), accumulated
+    over row tiles via the revisited output block."""
+    r = pl.program_id(0)
+    djp_re_m, djp_im_m = _scatter_gain_grads(djp[0], djp[1], MP, T, nc, cmap)
+    djq_re_m, djq_im_m = _scatter_gain_grads(djq[0], djq[1], MP, T, nc, cmap)
     dre = (jnp.dot(djp_re_m, ohp.T, preferred_element_type=jnp.float32)
            + jnp.dot(djq_re_m, ohq.T, preferred_element_type=jnp.float32))
     dim = (jnp.dot(djp_im_m, ohp.T, preferred_element_type=jnp.float32)
@@ -238,40 +316,58 @@ def _bwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, g_ref,
         dtabim_ref[:] = dtabim_ref[:] + dim
 
 
-def _fused_predict_bwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, g_ri,
-                            *, tile):
-    M4p, _ = tab_re.shape
-    Mp, F, _, rowsp = coh_ri.shape
-    R = rowsp // tile
+def _bwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, g_ref,
+                dtabre_ref, dtabim_ref, *, F, MP, T):
+    ohp, ohq = _onehots(antp_ref, antq_ref, T)
+    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T)
+    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T)
+    djp, djq = _bwd_accumulate(coh_ref, g_ref, p_re, p_im, q_re, q_im,
+                               F, MP, T)
+    _bwd_store(dtabre_ref, dtabim_ref, djp, djq, ohp, ohq, MP, T)
 
-    kernel = functools.partial(_bwd_kernel, F=F, MP=Mp, T=tile)
+
+def _bwd_kernel_hybrid(antp_ref, antq_ref, cmap_ref, tabre_ref, tabim_ref,
+                       coh_ref, g_ref, dtabre_ref, dtabim_ref,
+                       *, F, MP, T, NC):
+    ohp, ohq = _onehots(antp_ref, antq_ref, T)
+    cmap = cmap_ref[:]
+    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T, NC, cmap)
+    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T, NC, cmap)
+    djp, djq = _bwd_accumulate(coh_ref, g_ref, p_re, p_im, q_re, q_im,
+                               F, MP, T)
+    _bwd_store(dtabre_ref, dtabim_ref, djp, djq, ohp, ohq, MP, T, NC, cmap)
+
+
+def _fused_predict_bwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, g_ri,
+                            *, tile, nc=1, cmap=None):
+    M4p, _ = tab_re.shape
+    Mp, F, rowsp, R = _shape_args(tab_re, coh_ri, tile, nc)
+    g_spec = pl.BlockSpec((F, 8, tile), lambda r: (0, 0, r),
+                          memory_space=pltpu.VMEM)
+    if nc == 1:
+        kernel = functools.partial(_bwd_kernel, F=F, MP=Mp, T=tile)
+        specs = [_row_spec(tile), _row_spec(tile),
+                 _tab_spec(4 * Mp), _tab_spec(4 * Mp),
+                 _coh_spec(Mp, F, tile), g_spec]
+        args = (ant_p, ant_q, tab_re, tab_im, coh_ri, g_ri)
+    else:
+        kernel = functools.partial(_bwd_kernel_hybrid, F=F, MP=Mp, T=tile,
+                                   NC=nc)
+        specs = [_row_spec(tile), _row_spec(tile), _cmap_spec(Mp, tile),
+                 _tab_spec(4 * Mp * nc), _tab_spec(4 * Mp * nc),
+                 _coh_spec(Mp, F, tile), g_spec]
+        args = (ant_p, ant_q, cmap, tab_re, tab_im, coh_ri, g_ri)
     return pl.pallas_call(
         kernel,
         grid=(R,),
-        in_specs=[
-            pl.BlockSpec((1, tile), lambda r: (0, r), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tile), lambda r: (0, r), memory_space=pltpu.VMEM),
-            pl.BlockSpec((4 * Mp, NPAD), lambda r: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((4 * Mp, NPAD), lambda r: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((Mp, F, 8, tile), lambda r: (0, 0, 0, r),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((F, 8, tile), lambda r: (0, 0, r),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((4 * Mp, NPAD), lambda r: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((4 * Mp, NPAD), lambda r: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=specs,
+        out_specs=[_tab_spec(M4p), _tab_spec(M4p)],
         out_shape=[
             jax.ShapeDtypeStruct((M4p, NPAD), jnp.float32),
             jax.ShapeDtypeStruct((M4p, NPAD), jnp.float32),
         ],
         interpret=_use_interpret(),
-    )(ant_p, ant_q, tab_re, tab_im, coh_ri, g_ri)
+    )(*args)
 
 
 # ------------------------------------------------------------ public API
@@ -306,6 +402,35 @@ def _vjp_bwd(tile, res, g_ri):
 fused_predict_packed.defvjp(_vjp_fwd, _vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def fused_predict_packed_hybrid(tab_re, tab_im, coh_ri, ant_p, ant_q, cmap,
+                                nc, tile=DEF_TILE):
+    """Hybrid-chunk variant (reference nchunk > 1, lmfit.c:86-87):
+    ``tab_re/tab_im`` are (4*Mp*nc, NPAD) with one row block per
+    (cluster, chunk), ``cmap`` (Mp, rowsp) int32 selects each row's
+    chunk.  ``nc`` is static."""
+    return _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
+                                   tile=tile, nc=nc, cmap=cmap)
+
+
+def _vjp_fwd_h(tab_re, tab_im, coh_ri, ant_p, ant_q, cmap, nc, tile):
+    out = _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
+                                  tile=tile, nc=nc, cmap=cmap)
+    return out, (tab_re, tab_im, coh_ri, ant_p, ant_q, cmap)
+
+
+def _vjp_bwd_h(nc, tile, res, g_ri):
+    tab_re, tab_im, coh_ri, ant_p, ant_q, cmap = res
+    dre, dim = _fused_predict_bwd_impl(
+        tab_re, tab_im, coh_ri, ant_p, ant_q, g_ri, tile=tile, nc=nc,
+        cmap=cmap,
+    )
+    return dre, dim, None, None, None, None
+
+
+fused_predict_packed_hybrid.defvjp(_vjp_fwd_h, _vjp_bwd_h)
+
+
 # --------------------------------------------------- packing conveniences
 
 
@@ -314,12 +439,16 @@ def pad_to(n: int, mult: int) -> int:
 
 
 def pack_gain_tables(jones, mp: int):
-    """(M, N, 2, 2) complex Jones -> (tab_re, tab_im) of shape
-    (4*mp, NPAD) f32, rows ``4*m + comp`` comp row-major."""
-    M, N = jones.shape[0], jones.shape[1]
-    flat = jones.reshape(M, N, 4)  # row-major J00, J01, J10, J11
-    tab = jnp.transpose(flat, (0, 2, 1)).reshape(4 * M, N)
-    tab = jnp.pad(tab, ((0, 4 * mp - 4 * M), (0, NPAD - N)))
+    """(M, N, 2, 2) — or (M, nc, N, 2, 2) hybrid — complex Jones ->
+    (tab_re, tab_im) of shape (4*mp*nc, NPAD) f32, rows
+    ``(m*nc + c)*4 + comp`` with comp row-major."""
+    if jones.ndim == 5:
+        M, nc, N = jones.shape[0], jones.shape[1], jones.shape[2]
+    else:
+        M, nc, N = jones.shape[0], 1, jones.shape[1]
+    flat = jones.reshape(M * nc, N, 4)  # row-major J00, J01, J10, J11
+    tab = jnp.transpose(flat, (0, 2, 1)).reshape(4 * M * nc, N)
+    tab = jnp.pad(tab, ((0, 4 * nc * (mp - M)), (0, NPAD - N)))
     return (jnp.real(tab).astype(jnp.float32),
             jnp.imag(tab).astype(jnp.float32))
 

@@ -87,7 +87,6 @@ def test_two_process_mesh_admm_matches_single_process():
     import jax
     from jax.sharding import Mesh
 
-    sys.path.insert(0, HERE)
     import mh_common
     from sagecal_tpu.parallel.mesh import make_admm_mesh_fn
     from sagecal_tpu.solvers.lm import LMConfig
