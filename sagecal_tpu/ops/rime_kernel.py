@@ -408,7 +408,10 @@ def fused_predict_packed_hybrid(tab_re, tab_im, coh_ri, ant_p, ant_q, cmap,
     """Hybrid-chunk variant (reference nchunk > 1, lmfit.c:86-87):
     ``tab_re/tab_im`` are (4*Mp*nc, NPAD) with one row block per
     (cluster, chunk), ``cmap`` (Mp, rowsp) int32 selects each row's
-    chunk.  ``nc`` is static."""
+    chunk.  ``nc`` is static.  Differentiable w.r.t.
+    ``tab_re``/``tab_im`` ONLY — gradients w.r.t. ``coh_ri`` are
+    silently zero (wrap it in ``jax.lax.stop_gradient`` at call
+    sites)."""
     return _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
                                    tile=tile, nc=nc, cmap=cmap)
 
@@ -451,6 +454,38 @@ def pack_gain_tables(jones, mp: int):
     tab = jnp.pad(tab, ((0, 4 * nc * (mp - M)), (0, NPAD - N)))
     return (jnp.real(tab).astype(jnp.float32),
             jnp.imag(tab).astype(jnp.float32))
+
+
+def pack_predict_inputs(vis, mask, coh, ant_p, ant_q, chunk_map=None,
+                        tile=DEF_TILE):
+    """Pad/pack complex (F, 4, rows) visibilities, (M, F, 4, rows)
+    coherencies, mask and antenna indices into the kernel's layout
+    contract: rows padded to a multiple of ``tile``, clusters padded to
+    a multiple of 8, re/im concatenated on the component axis, ant
+    indices as (1, rowsp) int32.  Returns
+    (vis_ri, mask_p, coh_ri, antp, antq, cmap_or_None).  jnp-based: use
+    inside jit (padded regions carry zero coherency and zero mask, so
+    they contribute nothing to any cost or gradient)."""
+    M, rows = coh.shape[0], coh.shape[-1]
+    mp = pad_to(M, 8)
+    rowsp = pad_to(rows, tile)
+    pad_r = rowsp - rows
+    coh_ri = jnp.concatenate(
+        [jnp.real(coh), jnp.imag(coh)], axis=-2
+    ).astype(jnp.float32)
+    coh_ri = jnp.pad(coh_ri, ((0, mp - M), (0, 0), (0, 0), (0, pad_r)))
+    vis_ri = jnp.concatenate(
+        [jnp.real(vis), jnp.imag(vis)], axis=-2
+    ).astype(jnp.float32)
+    vis_ri = jnp.pad(vis_ri, ((0, 0), (0, 0), (0, pad_r)))
+    mask_p = jnp.pad(mask.astype(jnp.float32), ((0, 0), (0, pad_r)))
+    antp = jnp.pad(ant_p.astype(jnp.int32)[None, :], ((0, 0), (0, pad_r)))
+    antq = jnp.pad(ant_q.astype(jnp.int32)[None, :], ((0, 0), (0, pad_r)))
+    cmap = None
+    if chunk_map is not None:
+        cmap = jnp.pad(chunk_map.astype(jnp.int32),
+                       ((0, mp - M), (0, pad_r)))
+    return vis_ri, mask_p, coh_ri, antp, antq, cmap
 
 
 def unpack_gain_grads(dre, dim, M: int, N: int):

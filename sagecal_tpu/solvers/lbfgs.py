@@ -214,7 +214,17 @@ def lbfgs_fit(
         sk = x1 - x
         yk = g1 - g
         yk = yk + jnp.where(gradnrm1 > 1e-3, 1e-6, 0.0) * sk  # lbfgs.c:871-874
-        rho_k = 1.0 / jnp.dot(yk, sk)
+        # Positive-curvature guard (f32 robustness): near a converged
+        # point y.s can underflow to 0 (or go negative on a noisy
+        # Armijo step); storing rho = 1/(y.s) = inf then poisons every
+        # later two-loop direction with inf*0 = NaN.  Require
+        # y.s > eps*|y||s| (relative, scale-free) before storing — the
+        # reference never hits this because its solver is f64
+        # throughout (lbfgs.c), where these products stay representable.
+        ys = jnp.dot(yk, sk)
+        curv_ok = ys > 1e-7 * jnp.linalg.norm(yk) * jnp.linalg.norm(sk)
+        store = store & curv_ok  # NaN/inf ys already fail curv_ok
+        rho_k = jnp.where(curv_ok, 1.0 / jnp.maximum(ys, 1e-38), 0.0)
         slot = mem.vacant
 
         def do_store(mem):

@@ -76,6 +76,11 @@ class SageConfig:
     # demo test/Dirac/demo.c:90); bounding the solved gain parameters is
     # its natural calibration use (runaway-gain containment).
     param_bound: float = struct.field(pytree_node=False, default=0.0)
+    # Route the joint-LBFGS cost through the fused Pallas RIME kernel
+    # (ops/rime_kernel.py) — one pass over the coherency stack per
+    # evaluation vs the XLA predict's multiple buffer-scale
+    # intermediates.  f32 data only.
+    use_fused_predict: bool = struct.field(pytree_node=False, default=False)
     # Static ceiling multiplier for the weighted per-cluster iteration
     # allocation (lmfit.c:859-882): a high-error cluster may be granted up
     # to iter_budget_cap * max_iter iterations by the -R weighting.  The
@@ -290,6 +295,51 @@ def _res_norm(res, mask, nreal):
     return jnp.sqrt(jnp.sum(jnp.abs(r) ** 2)) / nreal
 
 
+def _make_fused_joint_cost(data, cdata, M, nchunk_max, n8, robust, mean_nu):
+    """Joint-LBFGS cost through the fused Pallas RIME kernel
+    (ops/rime_kernel.py) instead of the XLA predict — same math, one
+    pass over the coherency stack per evaluation.  The packed/padded
+    arrays are built ONCE here (they are constants of the LBFGS loop).
+    f32 only: the kernel computes in float32."""
+    from sagecal_tpu.ops.rime_kernel import (
+        DEF_TILE, fused_predict_packed, fused_predict_packed_hybrid,
+        pack_gain_tables, pack_predict_inputs, pad_to,
+    )
+
+    if jnp.real(data.vis).dtype != jnp.float32:
+        raise ValueError(
+            "use_fused_predict requires float32 data (the Pallas kernel "
+            "computes in f32); run with f64 disabled or use the XLA path"
+        )
+    mp = pad_to(M, 8)
+    vis_ri, mask_p, coh_ri, antp, antq, cmap = pack_predict_inputs(
+        data.vis, data.mask, cdata.coh, data.ant_p, data.ant_q,
+        cdata.chunk_map if nchunk_max > 1 else None, DEF_TILE,
+    )
+    coh_c = jax.lax.stop_gradient(coh_ri)
+
+    def cost_fn(pflat):
+        jones = params_to_jones(
+            pflat.reshape(M, nchunk_max, n8).astype(jnp.float32)
+        )  # (M, nchunk, N, 2, 2)
+        if nchunk_max > 1:
+            tre, tim = pack_gain_tables(jones, mp)
+            model = fused_predict_packed_hybrid(
+                tre, tim, coh_c, antp, antq, cmap, nchunk_max, DEF_TILE
+            )
+        else:
+            tre, tim = pack_gain_tables(jones[:, 0], mp)
+            model = fused_predict_packed(tre, tim, coh_c, antp, antq,
+                                         DEF_TILE)
+        d = (vis_ri - model) * mask_p[:, None, :]
+        e2 = d[:, :4, :] ** 2 + d[:, 4:, :] ** 2
+        if robust:
+            return jnp.sum(jnp.log1p(e2 / mean_nu))
+        return jnp.sum(e2)
+
+    return cost_fn
+
+
 def sagefit(
     data: VisData,
     cdata: ClusterData,
@@ -426,14 +476,20 @@ def sagefit(
     if config.max_lbfgs > 0:
         pflat0 = p.reshape(-1)
 
-        def cost_fn(pflat):
-            pa = pflat.reshape(M, nchunk_max, n8)
-            model = predict_full_model(pa, cdata, data)
-            diff = (data.vis - model) * data.mask[..., None, :]
-            e2 = jnp.real(diff) ** 2 + jnp.imag(diff) ** 2
-            if robust:
-                return jnp.sum(jnp.log1p(e2 / mean_nu))
-            return jnp.sum(e2)
+        if config.use_fused_predict:
+            cost_fn = _make_fused_joint_cost(
+                data, cdata, M, nchunk_max, n8, robust, mean_nu
+            )
+        else:
+
+            def cost_fn(pflat):
+                pa = pflat.reshape(M, nchunk_max, n8)
+                model = predict_full_model(pa, cdata, data)
+                diff = (data.vis - model) * data.mask[..., None, :]
+                e2 = jnp.real(diff) ** 2 + jnp.imag(diff) ** 2
+                if robust:
+                    return jnp.sum(jnp.log1p(e2 / mean_nu))
+                return jnp.sum(e2)
 
         if config.param_bound > 0.0:
             from sagecal_tpu.solvers.lbfgsb import lbfgsb_fit

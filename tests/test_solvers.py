@@ -154,3 +154,47 @@ def test_robust_lm_with_outliers():
     err_gau = _gain_consistency_err(j_gau, J[0], coh, obs.ant_p, obs.ant_q)
     assert err_rob < err_gau, (err_rob, err_gau)
     assert err_rob < 0.05, err_rob
+
+
+def test_lbfgs_f32_no_nan_after_converged_em():
+    """f32-without-x64 regression: the joint LBFGS pass starting from an
+    already-converged EM solution must not NaN.  Pre-guard, a curvature
+    pair with y.s underflowing to 0 stored rho = inf and poisoned every
+    later two-loop direction (TPU production is f32; run in a subprocess
+    so jax_enable_x64 from conftest does not mask the underflow)."""
+    import os
+    import subprocess
+    import sys
+
+    code = """
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from sagecal_tpu.core.types import identity_jones, jones_to_params
+from sagecal_tpu.io.simulate import corrupt_and_observe, make_visdata, random_jones
+from sagecal_tpu.ops.rime import point_source_batch
+from sagecal_tpu.solvers.sage import SM_LM_LBFGS, SageConfig, build_cluster_data, sagefit
+
+f0 = 150e6
+data = make_visdata(nstations=6, tilesz=2, nchan=1, freq0=f0, dtype=np.float32, seed=9)
+clusters = [point_source_batch([0.015], [0.01], [2.0], f0=f0, dtype=jnp.float32)]
+jt = random_jones(1, 6, seed=4, amp=0.1, dtype=np.complex64)
+data = corrupt_and_observe(data, clusters, jones=jt, noise_sigma=0.0)
+cdata = build_cluster_data(data, clusters, [1], fdelta=0.0)
+p0 = jones_to_params(jnp.broadcast_to(identity_jones(6, jnp.complex64), (1, 1, 6, 2, 2)))
+cfg = SageConfig(max_emiter=1, max_iter=10, max_lbfgs=15,
+                 solver_mode=SM_LM_LBFGS, randomize=False)
+r = sagefit(data, cdata, p0, cfg)
+assert np.isfinite(float(r.res_1)), float(r.res_1)
+assert float(r.res_1) < 1e-3 * float(r.res_0), float(r.res_1)
+print("F32OK")
+"""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=300, env=env)
+    assert r.returncode == 0 and "F32OK" in r.stdout, r.stdout + r.stderr
