@@ -73,6 +73,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-P", "--npoly", type=int, default=2)
     ap.add_argument("-Q", "--poly-type", type=int, default=2)
     ap.add_argument("-r", "--admm-rho", type=float, default=5.0)
+    ap.add_argument("--fused", action="store_true",
+                    help="route the joint-LBFGS cost through the fused "
+                         "Pallas RIME kernel (f32 runs only)")
     ap.add_argument("--f32", action="store_true",
                     help="solve in float32 (TPU-native precision)")
     ap.add_argument("-V", "--verbose", action="store_true")
@@ -138,7 +141,14 @@ def config_from_args(args) -> RunConfig:
         use_f64=not args.f32,
         verbose=args.verbose,
         influence=args.influence,
+        use_fused_predict=args.fused,
     )
+
+
+def _warn_dropped_fused(args, log=print):
+    if args.fused and not args.f32:
+        log("warning: --fused requires --f32 (the Pallas kernel computes "
+            "in float32); the fused path is DISABLED for this f64 run")
 
 
 def main(argv=None):
@@ -149,6 +159,7 @@ def main(argv=None):
         ms_to_h5(argv[1], argv[2])
         return 0
     args = build_parser().parse_args(argv)
+    _warn_dropped_fused(args)
     cfg = config_from_args(args)
     # mode dispatch (main.cpp:295-307; -f selects the sagecal-mpi
     # equivalent, MPI/main.cpp:336)

@@ -53,6 +53,8 @@ class RunConfig:
     # per-channel re-fit after the averaged solve (-b, doChan;
     # fullbatch_mode.cpp:453-499)
     per_channel: bool = False
+    # joint-LBFGS cost through the fused Pallas RIME kernel (f32 only)
+    use_fused_predict: bool = False
     # per-cluster ADMM rho / spatial alpha file (-G, read_arho_fromfile)
     rho_file: Optional[str] = None
     # partial reruns: skip first K tiles, process at most T tiles

@@ -25,7 +25,7 @@ from sagecal_tpu.core.types import (
     params_to_jones,
 )
 from sagecal_tpu.io import solutions as solio
-from sagecal_tpu.io.dataset import VisDataset
+from sagecal_tpu.io.dataset import TilePrefetcher, VisDataset
 from sagecal_tpu.io.skymodel import load_sky
 from sagecal_tpu.ops.residual import calculate_residuals, simulate_visibilities
 from sagecal_tpu.solvers.robust import whiten_uv_weights
@@ -138,6 +138,7 @@ def run_fullbatch(cfg: RunConfig, log=print):
         max_lbfgs=cfg.max_lbfgs, lbfgs_m=cfg.lbfgs_m,
         solver_mode=cfg.solver_mode,
         nulow=cfg.nulow, nuhigh=cfg.nuhigh, randomize=cfg.randomize,
+        use_fused_predict=cfg.use_fused_predict and not cfg.use_f64,
     )
 
     sol_fh = None
@@ -171,19 +172,37 @@ def run_fullbatch(cfg: RunConfig, log=print):
 
     results = []
     ntiles_done = 0
-    for tile_no, t0 in enumerate(ds.tiles(cfg.tilesz)):
-        # -K/-T partial reruns (MPI/main.cpp:133-139)
-        if tile_no < cfg.skip_tiles:
-            continue
-        if cfg.max_tiles and ntiles_done >= cfg.max_tiles:
-            break
+    # -K/-T partial reruns (MPI/main.cpp:133-139) resolved up front so
+    # the prefetcher reads exactly the tiles the loop will consume
+    pairs = [
+        (i, t0) for i, t0 in enumerate(ds.tiles(cfg.tilesz))
+        if i >= cfg.skip_tiles
+    ]
+    if cfg.max_tiles:
+        pairs = pairs[: cfg.max_tiles]
+    load_kw = dict(min_uvcut=cfg.min_uvcut, max_uvcut=cfg.max_uvcut,
+                   dtype=dtype)
+    specs = [dict(average_channels=False, **load_kw)]
+    if not cfg.simulation_mode:
+        specs.append(dict(average_channels=True, **load_kw))
+    # Background-thread tile prefetch (io/dataset.py TilePrefetcher):
+    # the next tile's HDF5 read + packing overlaps this tile's solve —
+    # the reference's loadData-around-the-pipeline role.  The "load"
+    # profiling phase therefore measures the prefetch STALL, not the
+    # raw read.
+    prefetch_cm = TilePrefetcher(cfg.dataset, [t0 for _, t0 in pairs],
+                                 specs, cfg.tilesz, depth=1)
+    try:
+      prefetch = iter(prefetch_cm.__enter__())
+      for tile_no, t0 in pairs:
         ntiles_done += 1
         tic = time.time()
         with timer.phase("load"):
-            full = ds.load_tile(
-                t0, cfg.tilesz, average_channels=False,
-                min_uvcut=cfg.min_uvcut, max_uvcut=cfg.max_uvcut, dtype=dtype,
-            )
+            t0_chk, tiles = next(prefetch)
+            assert t0_chk == t0
+            full = tiles[0]
+            if not cfg.simulation_mode:
+                data = tiles[1]
         with timer.phase("coherencies"):
             cdata_full = _cdata(
                 full, t0, fdelta=meta.deltaf / max(meta.nchan, 1)
@@ -209,11 +228,6 @@ def run_fullbatch(cfg: RunConfig, log=print):
             log(f"tile {t0}: simulated ({time.time()-tic:.1f}s)")
             continue
 
-        with timer.phase("load"):
-            data = ds.load_tile(
-                t0, cfg.tilesz, average_channels=True,
-                min_uvcut=cfg.min_uvcut, max_uvcut=cfg.max_uvcut, dtype=dtype,
-            )
         if cfg.whiten:
             wts = jnp.sqrt(whiten_uv_weights(data.u, data.v, meta.freq0))
             data = data.replace(vis=data.vis * wts[None, None, :],
@@ -296,6 +310,10 @@ def run_fullbatch(cfg: RunConfig, log=print):
         )
         results.append((res0, res1))
 
+    finally:
+        # always reap the worker thread + its read handle, even when the
+        # solve/write raises mid-loop
+        prefetch_cm.__exit__(None, None, None)
     log(timer.run_summary())
     stop_trace()
     if sol_fh:

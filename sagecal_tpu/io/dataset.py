@@ -223,6 +223,97 @@ class VisDataset:
         return range(0, m.ntime, tilesz)
 
 
+class TilePrefetcher:
+    """Background-thread tile prefetch: overlaps the HDF5 read +
+    host-side packing of the NEXT tile with the solve of the current
+    one — the role the reference's loadData/writeData threading plays
+    around its solver pipeline (src/MS/fullbatch_mode.cpp tile loop).
+
+    Opens an INDEPENDENT read-only handle so the main thread's solution
+    /residual write-backs never share a File object with the reader
+    (h5py serializes HDF5 calls process-wide, so concurrent use is safe;
+    the overlap won is the numpy packing + any compute the solver does
+    while the reader waits on the library lock).
+
+    Usage::
+
+        with TilePrefetcher(path, t0_list, [spec1, spec2]) as pf:
+            for t0, (tile1, tile2) in pf:
+                ...
+
+    ``specs``: list of ``load_tile`` kwarg dicts — each yielded item
+    carries one loaded VisData per spec, in order.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, path: str, t0_list, specs, tilesz: int, depth: int = 1):
+        import queue
+        import threading
+
+        self._path = path
+        self._t0s = list(t0_list)
+        self._specs = [dict(s) for s in specs]
+        self._tilesz = tilesz
+        self._q = queue.Queue(maxsize=max(1, depth))
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._started = False
+
+    def _worker(self):
+        ds = None
+        try:
+            ds = VisDataset(self._path, "r")
+            for t0 in self._t0s:
+                try:
+                    loads = tuple(
+                        ds.load_tile(t0, self._tilesz, **spec)
+                        for spec in self._specs
+                    )
+                except Exception as e:  # propagate into the consumer
+                    self._q.put((t0, e))
+                    return
+                self._q.put((t0, loads))
+        except Exception as e:
+            # a failed open (file locking, deleted file) must reach the
+            # consumer instead of deadlocking its queue get
+            self._q.put((None, e))
+        finally:
+            if ds is not None:
+                try:
+                    ds.close()
+                except Exception:
+                    pass
+            self._q.put(self._SENTINEL)
+
+    def __enter__(self):
+        self._thread.start()
+        self._started = True
+        return self
+
+    def __exit__(self, *exc):
+        # drain so the worker can exit even on early break
+        if self._started:
+            while self._thread.is_alive():
+                try:
+                    item = self._q.get(timeout=0.1)
+                    if item is self._SENTINEL:
+                        break
+                except Exception:
+                    continue
+            self._thread.join(timeout=5.0)
+        return False
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._SENTINEL:
+                return
+            t0, payload = item
+            if isinstance(payload, Exception):
+                raise payload
+            yield t0, payload
+
+
 def create_dataset(
     path: str,
     u, v, w,  # (ntime, nbase) metres
