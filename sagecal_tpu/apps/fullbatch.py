@@ -171,7 +171,6 @@ def run_fullbatch(cfg: RunConfig, log=print):
         log(f"profiling: XLA trace -> {trace_dir}")
 
     results = []
-    ntiles_done = 0
     # -K/-T partial reruns (MPI/main.cpp:133-139) resolved up front so
     # the prefetcher reads exactly the tiles the loop will consume
     pairs = [
@@ -195,11 +194,14 @@ def run_fullbatch(cfg: RunConfig, log=print):
     try:
       prefetch = iter(prefetch_cm.__enter__())
       for tile_no, t0 in pairs:
-        ntiles_done += 1
         tic = time.time()
         with timer.phase("load"):
             t0_chk, tiles = next(prefetch)
-            assert t0_chk == t0
+            if t0_chk != t0:
+                raise RuntimeError(
+                    f"prefetch order mismatch: got tile {t0_chk}, "
+                    f"expected {t0}"
+                )
             full = tiles[0]
             if not cfg.simulation_mode:
                 data = tiles[1]

@@ -256,19 +256,31 @@ class TilePrefetcher:
         self._specs = [dict(s) for s in specs]
         self._tilesz = tilesz
         self._q = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._started = False
 
     def _worker(self):
+        import jax
+
+        from sagecal_tpu.utils.platform import cpu_device
+
         ds = None
         try:
             ds = VisDataset(self._path, "r")
             for t0 in self._t0s:
+                if self._stop.is_set():
+                    return
                 try:
-                    loads = tuple(
-                        ds.load_tile(t0, self._tilesz, **spec)
-                        for spec in self._specs
-                    )
+                    # host-pinned: prefetched tiles must NOT occupy
+                    # device HBM (up to current+queued+in-flight tiles
+                    # coexist); the consumer's first jitted use moves
+                    # them over
+                    with jax.default_device(cpu_device()):
+                        loads = tuple(
+                            ds.load_tile(t0, self._tilesz, **spec)
+                            for spec in self._specs
+                        )
                 except Exception as e:  # propagate into the consumer
                     self._q.put((t0, e))
                     return
@@ -291,7 +303,10 @@ class TilePrefetcher:
         return self
 
     def __exit__(self, *exc):
-        # drain so the worker can exit even on early break
+        # signal cancellation, then drain so the worker can exit even on
+        # early break (without the event it would load every remaining
+        # tile before seeing the sentinel consumed)
+        self._stop.set()
         if self._started:
             while self._thread.is_alive():
                 try:

@@ -1,2 +1,3 @@
 from sagecal_tpu.solvers import lbfgs, lbfgsb, lm, robust  # noqa: F401
 from sagecal_tpu.solvers.lbfgsb import LBFGSBResult, lbfgsb_fit  # noqa: F401
+from sagecal_tpu.solvers.sharded import pad_rows_to, sharded_joint_fit  # noqa: F401,E501
