@@ -34,17 +34,17 @@ import time
 
 import numpy as np
 
-# Measured 2026-07-29 on this container's CPU (JAX CPU backend, float64,
+# Measured 2026-07-30 on this container's CPU (JAX CPU backend, float64,
 # same workload/shape as below, single run after compile):
 #   python -c "import bench; print(bench._measure_cpu_subprocess(60))"
 # pinned per workload shape (tilesz -> iters/sec, f64 CPU):
 #   60 = the north-star shape (BASELINE.md graded config 1, -t 60);
-#        re-measured 2026-07-29 with the round-3 rows-minor layout +
-#        one-hot-matmul gains: 0.0212 it/s (the round-2 layout measured
-#        0.0142 — the TPU-first layout is also 1.5x faster on CPU)
+#        re-measured with the round-3 two-stage factored predict:
+#        0.0555 it/s (history: round-2 layout 0.0142, rows-minor layout
+#        0.0212 — every TPU-first restructuring also sped up the CPU)
 #    5 = the small shape used when falling back to the CPU platform
-#        (measured round 1: 0.407, round-2 code)
-_CPU_BASELINE_PINNED = {60: 0.0212, 5: 0.407}
+#        (re-measured same code: 0.663; round-1 code measured 0.407)
+_CPU_BASELINE_PINNED = {60: 0.0555, 5: 0.663}
 
 NSTATIONS = 62
 NCLUSTERS = 100

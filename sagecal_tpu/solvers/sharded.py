@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from sagecal_tpu.core.types import VisData
 from sagecal_tpu.solvers.lbfgs import lbfgs_fit
@@ -35,15 +35,16 @@ from sagecal_tpu.solvers.sage import ClusterData, predict_full_model
 
 
 def _rows_axis_spec(leaf, rows: int, axis_name: str):
-    """PartitionSpec sharding whichever dimension equals ``rows``."""
-    if not hasattr(leaf, "shape"):
+    """PartitionSpec sharding the rows axis.  The canonical layout puts
+    rows MINOR-MOST in every per-row array (core/types.py), so only the
+    last axis is ever a rows axis — matching by position, not by size,
+    avoids mis-sharding when another dimension coincidentally equals
+    the row count."""
+    if not hasattr(leaf, "shape") or leaf.ndim == 0:
         return P()
-    dims = [None] * leaf.ndim
-    for i, d in enumerate(leaf.shape):
-        if d == rows:
-            dims[i] = axis_name
-            break
-    return P(*dims)
+    if leaf.shape[-1] != rows:
+        return P()
+    return P(*([None] * (leaf.ndim - 1)), axis_name)
 
 
 def pad_rows_to(data: VisData, cdata: ClusterData, mult: int):
@@ -100,8 +101,6 @@ def sharded_joint_fit(
     )
 
     def local_fit(data_l, cdata_l, p0_l):
-        nreal_terms = None  # cost is a plain sum; no normalization needed
-
         def cost_fn(pflat):
             pa = pflat.reshape(shp)
             model = predict_full_model(pa, cdata_l, data_l)

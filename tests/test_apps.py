@@ -372,3 +372,46 @@ class TestMSBridge:
             np.testing.assert_allclose(
                 np.asarray(a["vis"]), np.asarray(b["vis"]), rtol=1e-6
             )
+
+
+class TestTilePrefetcher:
+    def test_yields_tiles_in_order_and_cancels(self, tmp_path):
+        import time
+
+        from sagecal_tpu.io.dataset import TilePrefetcher, VisDataset
+
+        path = str(tmp_path / "pf.h5")
+        _make_dataset(path, ntime=8, nchan=1)
+        ds = VisDataset(path, "r")
+        t0s = list(ds.tiles(2))
+        want = [np.asarray(ds.load_tile(t, 2, dtype=np.float64).vis)
+                for t in t0s]
+        ds.close()
+
+        spec = [dict(average_channels=False, dtype=np.float64)]
+        with TilePrefetcher(path, t0s, spec, 2, depth=1) as pf:
+            got = [(t0, np.asarray(tiles[0].vis)) for t0, tiles in pf]
+        assert [t for t, _ in got] == t0s
+        for (_, g), w in zip(got, want):
+            np.testing.assert_allclose(g, w)
+
+        # early exit: the cancellation event stops the worker promptly
+        pf2 = TilePrefetcher(path, t0s, spec, 2, depth=1)
+        with pf2 as p:
+            next(iter(p))  # consume one tile, then tear down
+        t0 = time.time()
+        pf2._thread.join(timeout=5.0)
+        assert not pf2._thread.is_alive()
+        assert time.time() - t0 < 5.0
+
+    def test_propagates_open_failure(self, tmp_path):
+        from sagecal_tpu.io.dataset import TilePrefetcher
+
+        with TilePrefetcher(str(tmp_path / "missing.h5"), [0],
+                            [dict()], 2) as pf:
+            try:
+                next(iter(pf))
+                raised = False
+            except Exception:
+                raised = True
+        assert raised
