@@ -20,7 +20,9 @@ writes each output block exactly once (no cross-step accumulation) and
 the kernel body is straight-line VPU/MXU code:
 
 1. build the station one-hot selectors from the tile's antenna indices,
-2. expand per-row gains with four MXU matmuls ``(4*Mp, NPAD) @ (NPAD, T)``,
+2. expand per-row gains with one MXU matmul per 2x2 component
+   ``(Mp, NPAD) @ (NPAD, T)`` (component-major tables: no sublane
+   reshapes anywhere in the nc=1 kernel bodies),
 3. evaluate the 2x2 RIME products ``Jp (C Jq^H)`` as component
    arithmetic on ``(Mp, T)`` vregs, reduce over clusters, store.
 
@@ -37,9 +39,10 @@ packed reals keep every buffer's minor-most axis long (rows), so the
 TPU (8, 128) tiling pads nothing (core/types.py layout rationale).
 
 Layout contracts:
-  tab_re/tab_im: (4*Mp, NPAD) gain tables, row ``4*m + comp`` with comp
-    row-major [J00, J01, J10, J11]; Mp = clusters padded to a multiple
-    of 8 (sublane alignment), NPAD = stations padded to 128.
+  tab_re/tab_im: (4, Mp*nc, NPAD) component-major gain tables — plane k
+    holds 2x2 component k (row-major [J00, J01, J10, J11]) for every
+    (cluster, chunk) row ``m*nc + c``; Mp = clusters padded to a
+    multiple of 8 (sublane alignment), NPAD = stations padded to 128.
   coh_ri: (Mp, F, 8, rowsp) packed coherencies, component axis
     [re XX, re XY, re YX, re YY, im XX, im XY, im YX, im YY].
   ant_p/ant_q: (1, rowsp) int32 station index per row.
@@ -64,49 +67,58 @@ def _use_interpret() -> bool:
 
 
 def _expand_gains(tabre_ref, tabim_ref, oh, mp, T, nc=1, cmap=None):
-    """(4*Mp*nc, NPAD) tables x (NPAD, T) one-hot -> 4 re + 4 im
-    (Mp, T) per-row gain components via MXU matmuls.
+    """(4, Mp*nc, NPAD) component-major tables x (NPAD, T) one-hot ->
+    4 re + 4 im (Mp, T) per-row gain components, one MXU matmul per
+    component — NO sublane reshapes in the nc=1 path (kept Mosaic-
+    friendly on purpose: minor-dim relayouts are a prime suspect in the
+    remote-compile stall documented in the verify skill).
 
     ``nc > 1`` is the reference's hybrid time-chunk mode (one solution
     per chunk of the tile, lmfit.c:86-87): the tables carry one row
     block per (cluster, chunk) and ``cmap`` (Mp, T) selects each row's
     chunk — a static unrolled select over the (small) chunk count."""
-    g_re = jnp.dot(tabre_ref[:], oh, preferred_element_type=jnp.float32)
-    g_im = jnp.dot(tabim_ref[:], oh, preferred_element_type=jnp.float32)
-    if nc == 1:
-        re = [g_re.reshape(mp, 4, T)[:, k, :] for k in range(4)]
-        im = [g_im.reshape(mp, 4, T)[:, k, :] for k in range(4)]
-        return re, im
-    gr = g_re.reshape(mp, nc, 4, T)
-    gi = g_im.reshape(mp, nc, 4, T)
-    sels = [(cmap == c).astype(jnp.float32) for c in range(nc)]  # (Mp, T)
     re, im = [], []
+    if nc == 1:
+        for k in range(4):
+            re.append(jnp.dot(tabre_ref[k], oh,
+                              preferred_element_type=jnp.float32))
+            im.append(jnp.dot(tabim_ref[k], oh,
+                              preferred_element_type=jnp.float32))
+        return re, im
+    sels = [(cmap == c).astype(jnp.float32) for c in range(nc)]  # (Mp, T)
     for k in range(4):
+        g_re = jnp.dot(tabre_ref[k], oh, preferred_element_type=jnp.float32)
+        g_im = jnp.dot(tabim_ref[k], oh, preferred_element_type=jnp.float32)
+        gr = g_re.reshape(mp, nc, T)  # leading-dim split only
+        gi = g_im.reshape(mp, nc, T)
         acc_r = acc_i = 0.0
         for c in range(nc):
-            acc_r = acc_r + sels[c] * gr[:, c, k, :]
-            acc_i = acc_i + sels[c] * gi[:, c, k, :]
+            acc_r = acc_r + sels[c] * gr[:, c, :]
+            acc_i = acc_i + sels[c] * gi[:, c, :]
         re.append(acc_r)
         im.append(acc_i)
     return re, im
 
 
-def _scatter_gain_grads(dj_re, dj_im, mp, T, nc, cmap):
-    """Inverse of the hybrid chunk select: route per-row gain
-    cotangents (4 x (Mp, T)) back to their (cluster, chunk) table rows
-    -> (4*Mp*nc, T) pair."""
+def _rowsum_dot(a, b):
+    """(Mp', T) x (NPAD, T) -> (Mp', NPAD), contracting T — dot_general
+    with the contraction on the trailing dims so no transpose op is
+    ever materialized."""
+    return jax.lax.dot_general(
+        a, b, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _chunk_route(dj, mp, T, nc, sels):
+    """Route one component's per-row cotangent (Mp, T) to its
+    per-(cluster, chunk) rows (Mp*nc, T) for the hybrid mode.
+    ``sels``: pre-computed chunk-selector masks (hoisted by the caller
+    so the 16 uses per backward body don't re-trace nc compares)."""
     if nc == 1:
-        dre = jnp.stack(dj_re, axis=1).reshape(4 * mp, T)
-        dim = jnp.stack(dj_im, axis=1).reshape(4 * mp, T)
-        return dre, dim
-    rows_r, rows_i = [], []
-    for c in range(nc):
-        sel = (cmap == c).astype(jnp.float32)
-        rows_r.append(jnp.stack([sel * d for d in dj_re], axis=1))
-        rows_i.append(jnp.stack([sel * d for d in dj_im], axis=1))
-    dre = jnp.stack(rows_r, axis=1).reshape(4 * mp * nc, T)
-    dim = jnp.stack(rows_i, axis=1).reshape(4 * mp * nc, T)
-    return dre, dim
+        return dj
+    parts = [(sels[c] * dj)[:, None, :] for c in range(nc)]
+    return jnp.concatenate(parts, axis=1).reshape(mp * nc, T)
 
 
 def _rime_products(c_re, c_im, p_re, p_im, q_re, q_im):
@@ -146,14 +158,15 @@ def _onehots(antp_ref, antq_ref, T):
 
 
 def _fwd_store(coh_ref, out_ref, p_re, p_im, q_re, q_im, F):
-    planes = []
+    # per-plane (1, T) slice stores — no stack/concatenate relayouts
     for f in range(F):
         c_re = [coh_ref[:, f, k, :] for k in range(4)]
         c_im = [coh_ref[:, f, 4 + k, :] for k in range(4)]
         v_re, v_im = _rime_products(c_re, c_im, p_re, p_im, q_re, q_im)
-        sums = [jnp.sum(v, axis=0, keepdims=True) for v in v_re + v_im]
-        planes.append(jnp.concatenate(sums, axis=0))  # (8, T)
-    out_ref[:] = jnp.stack(planes, axis=0)  # (F, 8, T)
+        for k in range(4):
+            out_ref[f, k:k + 1, :] = jnp.sum(v_re[k], axis=0, keepdims=True)
+            out_ref[f, 4 + k:5 + k, :] = jnp.sum(v_im[k], axis=0,
+                                                 keepdims=True)
 
 
 def _fwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, out_ref,
@@ -174,9 +187,9 @@ def _fwd_kernel_hybrid(antp_ref, antq_ref, cmap_ref, tabre_ref, tabim_ref,
 
 
 def _shape_args(tab_re, coh_ri, tile, nc):
-    M4p, npad = tab_re.shape
+    four, mrows, npad = tab_re.shape
     Mp, F, _, rowsp = coh_ri.shape
-    assert npad == NPAD and M4p == 4 * Mp * nc and Mp % 8 == 0
+    assert four == 4 and npad == NPAD and mrows == Mp * nc and Mp % 8 == 0
     assert rowsp % tile == 0, (rowsp, tile)
     return Mp, F, rowsp, rowsp // tile
 
@@ -186,7 +199,8 @@ def _row_spec(tile):
 
 
 def _tab_spec(nrows):
-    return pl.BlockSpec((nrows, NPAD), lambda r: (0, 0),
+    # component-major (4, Mp*nc, NPAD)
+    return pl.BlockSpec((4, nrows, NPAD), lambda r: (0, 0, 0),
                         memory_space=pltpu.VMEM)
 
 
@@ -206,13 +220,13 @@ def _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, *, tile,
     if nc == 1:
         kernel = functools.partial(_fwd_kernel, F=F, MP=Mp, T=tile)
         specs = [_row_spec(tile), _row_spec(tile),
-                 _tab_spec(4 * Mp), _tab_spec(4 * Mp), _coh_spec(Mp, F, tile)]
+                 _tab_spec(Mp), _tab_spec(Mp), _coh_spec(Mp, F, tile)]
         args = (ant_p, ant_q, tab_re, tab_im, coh_ri)
     else:
         kernel = functools.partial(_fwd_kernel_hybrid, F=F, MP=Mp, T=tile,
                                    NC=nc)
         specs = [_row_spec(tile), _row_spec(tile), _cmap_spec(Mp, tile),
-                 _tab_spec(4 * Mp * nc), _tab_spec(4 * Mp * nc),
+                 _tab_spec(Mp * nc), _tab_spec(Mp * nc),
                  _coh_spec(Mp, F, tile)]
         args = (ant_p, ant_q, cmap, tab_re, tab_im, coh_ri)
     return pl.pallas_call(
@@ -294,26 +308,28 @@ def _bwd_accumulate(coh_ref, g_ref, p_re, p_im, q_re, q_im, F, MP, T):
 
 def _bwd_store(dtabre_ref, dtabim_ref, djp, djq, ohp, ohq, MP, T, nc=1,
                cmap=None):
-    """Scatter per-row gain cotangents to table rows:
-    dtab[m4, n] += dJ (4*Mp*nc, T) @ onehot^T (T, NPAD), accumulated
-    over row tiles via the revisited output block."""
+    """Scatter per-row gain cotangents to table rows, one component at
+    a time: dtab[k] += dJ_k (Mp*nc, T) contracted with the one-hot over
+    T (dot_general on trailing dims — no transpose op), accumulated
+    over row tiles via the revisited (4, Mp*nc, NPAD) output block."""
     r = pl.program_id(0)
-    djp_re_m, djp_im_m = _scatter_gain_grads(djp[0], djp[1], MP, T, nc, cmap)
-    djq_re_m, djq_im_m = _scatter_gain_grads(djq[0], djq[1], MP, T, nc, cmap)
-    dre = (jnp.dot(djp_re_m, ohp.T, preferred_element_type=jnp.float32)
-           + jnp.dot(djq_re_m, ohq.T, preferred_element_type=jnp.float32))
-    dim = (jnp.dot(djp_im_m, ohp.T, preferred_element_type=jnp.float32)
-           + jnp.dot(djq_im_m, ohq.T, preferred_element_type=jnp.float32))
+    sels = (None if nc == 1 else
+            [(cmap == c).astype(jnp.float32) for c in range(nc)])
+    for k in range(4):
+        dre = (_rowsum_dot(_chunk_route(djp[0][k], MP, T, nc, sels), ohp)
+               + _rowsum_dot(_chunk_route(djq[0][k], MP, T, nc, sels), ohq))
+        dim = (_rowsum_dot(_chunk_route(djp[1][k], MP, T, nc, sels), ohp)
+               + _rowsum_dot(_chunk_route(djq[1][k], MP, T, nc, sels), ohq))
 
-    @pl.when(r == 0)
-    def _init():
-        dtabre_ref[:] = dre
-        dtabim_ref[:] = dim
+        @pl.when(r == 0)
+        def _init(dre=dre, dim=dim, k=k):
+            dtabre_ref[k] = dre
+            dtabim_ref[k] = dim
 
-    @pl.when(r != 0)
-    def _acc():
-        dtabre_ref[:] = dtabre_ref[:] + dre
-        dtabim_ref[:] = dtabim_ref[:] + dim
+        @pl.when(r != 0)
+        def _acc(dre=dre, dim=dim, k=k):
+            dtabre_ref[k] = dtabre_ref[k] + dre
+            dtabim_ref[k] = dtabim_ref[k] + dim
 
 
 def _bwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, g_ref,
@@ -340,31 +356,31 @@ def _bwd_kernel_hybrid(antp_ref, antq_ref, cmap_ref, tabre_ref, tabim_ref,
 
 def _fused_predict_bwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, g_ri,
                             *, tile, nc=1, cmap=None):
-    M4p, _ = tab_re.shape
     Mp, F, rowsp, R = _shape_args(tab_re, coh_ri, tile, nc)
+    mrows = Mp * nc
     g_spec = pl.BlockSpec((F, 8, tile), lambda r: (0, 0, r),
                           memory_space=pltpu.VMEM)
     if nc == 1:
         kernel = functools.partial(_bwd_kernel, F=F, MP=Mp, T=tile)
         specs = [_row_spec(tile), _row_spec(tile),
-                 _tab_spec(4 * Mp), _tab_spec(4 * Mp),
+                 _tab_spec(Mp), _tab_spec(Mp),
                  _coh_spec(Mp, F, tile), g_spec]
         args = (ant_p, ant_q, tab_re, tab_im, coh_ri, g_ri)
     else:
         kernel = functools.partial(_bwd_kernel_hybrid, F=F, MP=Mp, T=tile,
                                    NC=nc)
         specs = [_row_spec(tile), _row_spec(tile), _cmap_spec(Mp, tile),
-                 _tab_spec(4 * Mp * nc), _tab_spec(4 * Mp * nc),
+                 _tab_spec(Mp * nc), _tab_spec(Mp * nc),
                  _coh_spec(Mp, F, tile), g_spec]
         args = (ant_p, ant_q, cmap, tab_re, tab_im, coh_ri, g_ri)
     return pl.pallas_call(
         kernel,
         grid=(R,),
         in_specs=specs,
-        out_specs=[_tab_spec(M4p), _tab_spec(M4p)],
+        out_specs=[_tab_spec(mrows), _tab_spec(mrows)],
         out_shape=[
-            jax.ShapeDtypeStruct((M4p, NPAD), jnp.float32),
-            jax.ShapeDtypeStruct((M4p, NPAD), jnp.float32),
+            jax.ShapeDtypeStruct((4, mrows, NPAD), jnp.float32),
+            jax.ShapeDtypeStruct((4, mrows, NPAD), jnp.float32),
         ],
         interpret=_use_interpret(),
     )(*args)
@@ -406,9 +422,9 @@ fused_predict_packed.defvjp(_vjp_fwd, _vjp_bwd)
 def fused_predict_packed_hybrid(tab_re, tab_im, coh_ri, ant_p, ant_q, cmap,
                                 nc, tile=DEF_TILE):
     """Hybrid-chunk variant (reference nchunk > 1, lmfit.c:86-87):
-    ``tab_re/tab_im`` are (4*Mp*nc, NPAD) with one row block per
-    (cluster, chunk), ``cmap`` (Mp, rowsp) int32 selects each row's
-    chunk.  ``nc`` is static.  Differentiable w.r.t.
+    ``tab_re/tab_im`` are component-major (4, Mp*nc, NPAD) with one
+    row per (cluster, chunk) in each component plane, ``cmap``
+    (Mp, rowsp) int32 selects each row's chunk.  ``nc`` is static.  Differentiable w.r.t.
     ``tab_re``/``tab_im`` ONLY — gradients w.r.t. ``coh_ri`` are
     silently zero (wrap it in ``jax.lax.stop_gradient`` at call
     sites)."""
@@ -443,15 +459,16 @@ def pad_to(n: int, mult: int) -> int:
 
 def pack_gain_tables(jones, mp: int):
     """(M, N, 2, 2) — or (M, nc, N, 2, 2) hybrid — complex Jones ->
-    (tab_re, tab_im) of shape (4*mp*nc, NPAD) f32, rows
-    ``(m*nc + c)*4 + comp`` with comp row-major."""
+    component-major (tab_re, tab_im) of shape (4, mp*nc, NPAD) f32:
+    plane k holds component k (row-major [J00, J01, J10, J11]) for
+    every (cluster, chunk) row ``m*nc + c``."""
     if jones.ndim == 5:
         M, nc, N = jones.shape[0], jones.shape[1], jones.shape[2]
     else:
         M, nc, N = jones.shape[0], 1, jones.shape[1]
     flat = jones.reshape(M * nc, N, 4)  # row-major J00, J01, J10, J11
-    tab = jnp.transpose(flat, (0, 2, 1)).reshape(4 * M * nc, N)
-    tab = jnp.pad(tab, ((0, 4 * nc * (mp - M)), (0, NPAD - N)))
+    tab = jnp.transpose(flat, (2, 0, 1))  # (4, M*nc, N)
+    tab = jnp.pad(tab, ((0, 0), (0, nc * (mp - M)), (0, NPAD - N)))
     return (jnp.real(tab).astype(jnp.float32),
             jnp.imag(tab).astype(jnp.float32))
 
@@ -489,10 +506,9 @@ def pack_predict_inputs(vis, mask, coh, ant_p, ant_q, chunk_map=None,
 
 
 def unpack_gain_grads(dre, dim, M: int, N: int):
-    """Inverse of :func:`pack_gain_tables` for cotangents: (4*mp, NPAD)
-    pair -> complex-as-pair (M, N, 2, 2) re/im arrays."""
-    dre = dre[: 4 * M, :N].reshape(M, 4, N)
-    dim = dim[: 4 * M, :N].reshape(M, 4, N)
-    dre = jnp.transpose(dre, (0, 2, 1)).reshape(M, N, 2, 2)
-    dim = jnp.transpose(dim, (0, 2, 1)).reshape(M, N, 2, 2)
+    """Inverse of :func:`pack_gain_tables` for cotangents:
+    (4, mp*nc, NPAD) pair -> complex-as-pair (M, N, 2, 2) re/im
+    arrays (nc=1 tables)."""
+    dre = jnp.transpose(dre[:, :M, :N], (1, 2, 0)).reshape(M, N, 2, 2)
+    dim = jnp.transpose(dim[:, :M, :N], (1, 2, 0)).reshape(M, N, 2, 2)
     return dre, dim

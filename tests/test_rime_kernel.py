@@ -87,8 +87,8 @@ def test_gradients_match_autodiff_oracle():
 
     def loss_xla(tab_re, tab_im):
         """Same math as the kernel, in plain XLA, from the same packing."""
-        tab = (tab_re + 1j * tab_im)[: 4 * M, :N].reshape(M, 4, N)
-        jns = jnp.transpose(tab, (0, 2, 1)).reshape(M, N, 2, 2)
+        tab = (tab_re + 1j * tab_im)[:, :M, :N]  # (4, M, N)
+        jns = jnp.transpose(tab, (1, 2, 0)).reshape(M, N, 2, 2)
         jp = jns[:, antp_j[0, :]]  # (M, rowsp, 2, 2)
         jq = jns[:, antq_j[0, :]]
         c = jax.lax.complex(coh_j[:M, :, :4, :], coh_j[:M, :, 4:, :])
@@ -108,8 +108,8 @@ def test_gradients_match_autodiff_oracle():
     # padded table rows/cols receive zero gradient
     dre, dim = unpack_gain_grads(*gk, M, N)
     assert np.all(np.isfinite(np.asarray(dre)))
-    np.testing.assert_array_equal(np.asarray(gk[0])[4 * M:, :], 0.0)
-    np.testing.assert_array_equal(np.asarray(gk[0])[:, N:], 0.0)
+    np.testing.assert_array_equal(np.asarray(gk[0])[:, M:, :], 0.0)
+    np.testing.assert_array_equal(np.asarray(gk[0])[:, :, N:], 0.0)
 
 
 @pytest.mark.parametrize("F", [1, 2])
@@ -184,8 +184,8 @@ def test_hybrid_chunks_match_oracle():
     # grads: kernel custom-vjp vs autodiff of an XLA replica of the
     # same packed computation
     def loss_xla(tre, tim):
-        tab = (tre + 1j * tim)[: 4 * M * nc, :N].reshape(M, nc, 4, N)
-        jns = jnp.transpose(tab, (0, 1, 3, 2)).reshape(M, nc, N, 2, 2)
+        tab = (tre + 1j * tim)[:, : M * nc, :N].reshape(4, M, nc, N)
+        jns = jnp.transpose(tab, (1, 2, 3, 0)).reshape(M, nc, N, 2, 2)
         cm = jnp.asarray(cmap_full)
         jp_ = jns[jnp.arange(M)[:, None], cm, jnp.asarray(ant_p)[None, :]]
         jq_ = jns[jnp.arange(M)[:, None], cm, jnp.asarray(ant_q)[None, :]]
