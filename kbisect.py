@@ -1,0 +1,202 @@
+"""Bisect which kernel feature stalls the axon Mosaic remote compile.
+
+Tiny shapes throughout; variants ordered by increasing complexity.  Run:
+    python kbisect.py c b a d
+Each variant prints before/after; the first one that never prints "ok"
+is the culprit.  Keep timeouts short — a stalled compile serializes the
+relay for every later process.
+"""
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+T, MP, NPAD, F, R = 256, 8, 128, 1, 2
+INTERP = jax.default_backend() not in ("tpu",)
+
+
+def variant_c():
+    """No grid: one block, MXU dot + sublane reshape-slice + reduce."""
+    def k(tab_ref, oh_ref, out_ref):
+        g = jnp.dot(tab_ref[:], oh_ref[:], preferred_element_type=jnp.float32)
+        comps = [g.reshape(MP, 4, T)[:, kk, :] for kk in range(4)]
+        s = comps[0] * comps[1] + comps[2] * comps[3]
+        out_ref[:] = jnp.sum(s, axis=0, keepdims=True)
+
+    rng = np.random.default_rng(0)
+    tab = rng.standard_normal((4 * MP, NPAD)).astype(np.float32)
+    oh = rng.standard_normal((NPAD, T)).astype(np.float32)
+
+    @jax.jit
+    def f(tab, oh):
+        return jnp.sum(pl.pallas_call(
+            k,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((1, T), jnp.float32),
+            interpret=INTERP,
+        )(tab, oh))
+
+    return f, (tab, oh)
+
+
+def variant_b():
+    """Grid over rows, 4D coh block + middle-index slicing + reduce."""
+    def k(coh_ref, out_ref):
+        sums = []
+        for kk in range(8):
+            x = coh_ref[:, 0, kk, :]  # (MP, T)
+            sums.append(jnp.sum(x * x, axis=0, keepdims=True))
+        out_ref[:] = jnp.concatenate(sums, axis=0)[None]
+
+    rng = np.random.default_rng(0)
+    coh = rng.standard_normal((MP, F, 8, R * T)).astype(np.float32)
+
+    @jax.jit
+    def f(coh):
+        return jnp.sum(pl.pallas_call(
+            k,
+            grid=(R,),
+            in_specs=[pl.BlockSpec((MP, F, 8, T), lambda r: (0, 0, 0, r),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((F, 8, T), lambda r: (0, 0, r),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((F, 8, R * T), jnp.float32),
+            interpret=INTERP,
+        )(coh))
+
+    return f, (coh,)
+
+
+def variant_a():
+    """int32 input + in-kernel iota one-hot + dot + output revisit
+    accumulation across the grid."""
+    def k(antp_ref, tab_ref, out_ref):
+        r = pl.program_id(0)
+        n_iota = jax.lax.broadcasted_iota(jnp.int32, (NPAD, T), 0)
+        oh = (n_iota == antp_ref[:]).astype(jnp.float32)
+        g = jnp.dot(tab_ref[:], oh, preferred_element_type=jnp.float32)
+        acc = jnp.sum(g.reshape(MP, 4, T), axis=0)[None]  # (1, 4, T)
+
+        @pl.when(r == 0)
+        def _i():
+            out_ref[:] = acc
+
+        @pl.when(r != 0)
+        def _a():
+            out_ref[:] = out_ref[:] + acc
+
+    rng = np.random.default_rng(0)
+    antp = rng.integers(0, 62, (1, R * T)).astype(np.int32)
+    tab = rng.standard_normal((4 * MP, NPAD)).astype(np.float32)
+
+    @jax.jit
+    def f(antp, tab):
+        return jnp.sum(pl.pallas_call(
+            k,
+            grid=(R,),
+            in_specs=[
+                pl.BlockSpec((1, T), lambda r: (0, r),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((4 * MP, NPAD), lambda r: (0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, 4, T), lambda r: (0, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((1, 4, T), jnp.float32),
+            interpret=INTERP,
+        )(antp, tab))
+
+    return f, (antp, tab)
+
+
+def variant_d():
+    """The actual v2 forward kernel at tiny shape."""
+    from sagecal_tpu.ops.rime_kernel import fused_predict_packed
+
+    rng = np.random.default_rng(0)
+    coh = rng.standard_normal((MP, F, 8, R * T)).astype(np.float32)
+    tre = rng.standard_normal((4, MP, NPAD)).astype(np.float32)
+    tim = rng.standard_normal((4, MP, NPAD)).astype(np.float32)
+    antp = rng.integers(0, 62, (1, R * T)).astype(np.int32)
+    antq = rng.integers(0, 62, (1, R * T)).astype(np.int32)
+
+    @jax.jit
+    def f(tre, tim, coh, antp, antq):
+        return jnp.sum(fused_predict_packed(tre, tim, coh, antp, antq, T))
+
+    return f, (tre, tim, coh, antp, antq)
+
+
+def variant_e():
+    """The actual v2 backward kernel at tiny shape."""
+    from sagecal_tpu.ops.rime_kernel import fused_predict_packed
+
+    rng = np.random.default_rng(0)
+    coh = rng.standard_normal((MP, F, 8, R * T)).astype(np.float32)
+    tre = rng.standard_normal((4, MP, NPAD)).astype(np.float32)
+    tim = rng.standard_normal((4, MP, NPAD)).astype(np.float32)
+    antp = rng.integers(0, 62, (1, R * T)).astype(np.int32)
+    antq = rng.integers(0, 62, (1, R * T)).astype(np.int32)
+
+    @jax.jit
+    def f(tre, tim, coh, antp, antq):
+        def loss(a, b):
+            return jnp.sum(fused_predict_packed(a, b, coh, antp, antq, T))
+        ga, gb = jax.grad(loss, argnums=(0, 1))(tre, tim)
+        return jnp.sum(ga) + jnp.sum(gb)
+
+    return f, (tre, tim, coh, antp, antq)
+
+
+VARIANTS = {"a": variant_a, "b": variant_b, "c": variant_c,
+            "d": variant_d, "e": variant_e}
+
+if __name__ == "__main__":
+    for name in sys.argv[1:]:
+        print(f"[{name}] building...", flush=True)
+        f, args = VARIANTS[name]()
+        dev = jax.devices()[0]
+        args = tuple(jax.device_put(a, dev) for a in args)
+        t0 = time.time()
+        v = float(np.asarray(f(*args)))
+        print(f"[{name}] ok: {time.time()-t0:.1f}s val={v:.5g}", flush=True)
+
+
+def variant_f():
+    """Reshape-free gains: component-major tables, one dot per comp."""
+    def k(antp_ref, tab_ref, out_ref):
+        n_iota = jax.lax.broadcasted_iota(jnp.int32, (NPAD, T), 0)
+        oh = (n_iota == antp_ref[:]).astype(jnp.float32)
+        comps = []
+        for kk in range(4):
+            g = jnp.dot(tab_ref[kk], oh, preferred_element_type=jnp.float32)
+            comps.append(g)  # (MP, T)
+        s = comps[0] * comps[1] + comps[2] * comps[3]
+        out_ref[:] = jnp.sum(s, axis=0, keepdims=True)
+
+    rng = np.random.default_rng(0)
+    antp = rng.integers(0, 62, (1, T)).astype(np.int32)
+    tab = rng.standard_normal((4, MP, NPAD)).astype(np.float32)
+
+    @jax.jit
+    def f(antp, tab):
+        return jnp.sum(pl.pallas_call(
+            k,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((1, T), jnp.float32),
+            interpret=INTERP,
+        )(antp, tab))
+
+    return f, (antp, tab)
+
+
+VARIANTS["f"] = variant_f

@@ -1,0 +1,54 @@
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from sagecal_tpu.ops.rime_kernel import fused_predict_packed  # noqa: E402
+
+TILE, MC = 512, 8
+
+
+def run(mp, F, rowsp, ns=62):
+    rng = np.random.default_rng(0)
+    coh = rng.standard_normal((mp, F, 8, rowsp)).astype(np.float32)
+    tre = rng.standard_normal((4, mp, 128)).astype(np.float32)
+    tim = rng.standard_normal((4, mp, 128)).astype(np.float32)
+    antp = rng.integers(0, ns, (1, rowsp)).astype(np.int32)
+    antq = rng.integers(0, ns, (1, rowsp)).astype(np.int32)
+    dev = jax.devices()[0]
+    coh, tre, tim, antp, antq = (
+        jax.device_put(a, dev) for a in (coh, tre, tim, antp, antq)
+    )
+
+    @jax.jit
+    def f(tre, tim):
+        return jnp.sum(fused_predict_packed(tre, tim, coh, antp, antq, TILE))
+
+    t0 = time.time()
+    v = float(np.asarray(f(tre, tim)))
+    print(f"mp={mp} F={F} rowsp={rowsp}: compile+run {time.time()-t0:.1f}s "
+          f"val={v:.4g}", flush=True)
+    ts = []
+    for _ in range(3):
+        t0 = time.time()
+        float(np.asarray(f(tre, tim)))
+        ts.append(time.time() - t0)
+    dt = sorted(ts)[1]
+    print(f"  steady {dt*1e3:.2f} ms  BW {coh.size*4/dt/1e9:.0f} GB/s",
+          flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "small"
+    if which == "small":
+        run(8, 2, 4096)
+    elif which == "mid":
+        run(40, 2, 32768)
+    elif which == "full":
+        run(104, 2, 113664)  # north-star padded shape

@@ -1,0 +1,27 @@
+#!/bin/bash
+# One-shot on-chip validation sequence for the fused RIME kernel.
+# Run when the axon tunnel is healthy.  Stops at the first hang so the
+# tunnel isn't re-wedged by stacked compiles (verify skill gotchas 5+7).
+set -u
+cd /root/repo
+probe() {
+  timeout 75 python -c "import jax; print(jax.devices())" 2>/dev/null | grep -q TPU
+}
+step() {  # step <name> <timeout> <cmd...>
+  local name=$1 to=$2; shift 2
+  echo "=== $name"
+  if ! probe; then echo "TUNNEL WEDGED before $name - stop"; exit 1; fi
+  timeout "$to" "$@" 2>&1 | grep -v WARNING | tail -4
+  local rc=${PIPESTATUS[0]}
+  if [ "$rc" != 0 ]; then echo "$name FAILED rc=$rc - stop"; exit 1; fi
+}
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+step bisect-c 200 python kbisect.py c
+step bisect-b 200 python kbisect.py b
+step bisect-a 200 python kbisect.py a
+step bisect-f 200 python kbisect.py f
+step kernel-fwd-small 300 python kbisect.py d
+step kernel-bwd-small 300 python kbisect.py e
+step kernel-full-shape 560 python kdiag.py full
+echo "=== fused bench (north-star)"
+if probe; then SAGECAL_BENCH_FUSED=1 timeout 560 python bench.py; fi
