@@ -1,0 +1,16 @@
+#!/bin/bash
+# Probe the tunnel every ~3 min; on recovery, detach tpu_kernel_check.sh.
+for i in $(seq 1 3); do
+  if timeout 75 python -c "import jax; print(jax.devices())" 2>/dev/null | grep -q TPU; then
+    echo "TUNNEL HEALTHY at $(date)" >> /root/repo/tpu_watch.log
+    if [ ! -f /root/repo/.tpu_check_started ]; then
+      touch /root/repo/.tpu_check_started
+      nohup /root/repo/tpu_kernel_check.sh > /root/repo/tpu_check.out 2>&1 &
+      echo "check launched" >> /root/repo/tpu_watch.log
+    fi
+    exit 0
+  fi
+  echo "wedged at $(date)" >> /root/repo/tpu_watch.log
+  sleep 160
+done
+exit 1
