@@ -36,7 +36,7 @@ from sagecal_tpu.core.types import (
     params_to_jones,
 )
 from sagecal_tpu.io import solutions as solio
-from sagecal_tpu.io.dataset import VisDataset
+from sagecal_tpu.io.dataset import TilePrefetcher, VisDataset
 from sagecal_tpu.io.skymodel import load_sky, read_cluster_rho
 from sagecal_tpu.ops.residual import calculate_residuals
 from sagecal_tpu.parallel import consensus
@@ -247,23 +247,44 @@ def _run_distributed_inner(
 
     traces = []
     tile_starts = list(range(0, ntime, cfg.tilesz))
-    ntiles_done = 0
-    for tile_no, t0 in enumerate(tile_starts):
-        if tile_no < cfg.skip_tiles:
-            continue
-        if cfg.max_tiles and ntiles_done >= cfg.max_tiles:
-            break
-        ntiles_done += 1
+    pairs = [(i, t0) for i, t0 in enumerate(tile_starts)
+             if i >= cfg.skip_tiles]
+    if cfg.max_tiles:
+        pairs = pairs[: cfg.max_tiles]
+    # Per-band background prefetch of the FULL-SIZE tiles (the final
+    # clamped partial tile loads directly): each band's next tile reads
+    # while the mesh ADMM solves the current one (TilePrefetcher,
+    # io/dataset.py — the fullbatch loop's loadData-overlap role).
+    spec = [dict(average_channels=True, min_uvcut=cfg.min_uvcut,
+                 max_uvcut=cfg.max_uvcut, dtype=dtype)]
+    full_t0s = [t0 for _, t0 in pairs
+                if min(cfg.tilesz, ntime - t0) == cfg.tilesz]
+    prefetchers = [
+        TilePrefetcher(path, full_t0s, spec, cfg.tilesz, depth=1)
+        for path in datasets
+    ]
+    pf_iters = []
+    try:
+      pf_iters = [iter(pf.__enter__()) for pf in prefetchers]
+      for tile_no, t0 in pairs:
         tic = time.time()
         datas, cdatas, fratios = [], [], []
         # clamp the tile to the COMMON timeslot range so bands with more
         # timeslots than ntime_min still produce equal row counts on the
         # final partial tile (stack_for_mesh needs identical shapes)
         eff_tilesz = min(cfg.tilesz, ntime - t0)
-        for h in handles:
-            d = h.load_tile(t0, eff_tilesz, average_channels=True,
-                            min_uvcut=cfg.min_uvcut,
-                            max_uvcut=cfg.max_uvcut, dtype=dtype)
+        for bi, h in enumerate(handles):
+            if eff_tilesz == cfg.tilesz:
+                t0_chk, (d,) = next(pf_iters[bi])
+                if t0_chk != t0:
+                    raise RuntimeError(
+                        f"band {bi} prefetch order mismatch: "
+                        f"{t0_chk} != {t0}"
+                    )
+            else:
+                # same kwargs as the prefetch spec so the two load
+                # paths can never drift apart
+                d = h.load_tile(t0, eff_tilesz, **spec[0])
             # static pytree fields must match across the stacked bands
             # (the per-channel ``freqs`` array carries each band's true
             # frequency; freq0/deltaf statics only matter pre-stack)
@@ -306,5 +327,9 @@ def _run_distributed_inner(
             f"tile {t0}: dual {float(out.dual_res[-1]):.3e} primal "
             f"{float(out.primal_res[-1]):.3e} ({time.time()-tic:.1f}s)"
         )
+    finally:
+        # reap every band's prefetch thread even on a mid-loop failure
+        for pf in prefetchers:
+            pf.__exit__(None, None, None)
 
     return traces

@@ -225,31 +225,30 @@ def test_sagefit_fused_joint_pass_matches_xla(nchunks):
         SM_LM_LBFGS, SageConfig, build_cluster_data, sagefit,
     )
 
-    if True:
-        f0 = 150e6
-        data = make_visdata(nstations=6, tilesz=2, nchan=1, freq0=f0,
-                            dtype=np.float32, seed=2)
-        clusters = [
-            point_source_batch([0.02], [0.01], [2.0], f0=f0,
-                               dtype=jnp.float32),
-            point_source_batch([-0.01], [0.02], [1.5], f0=f0,
-                               dtype=jnp.float32),
-        ]
-        jt = random_jones(2, 6, seed=3, amp=0.1, dtype=np.complex64)
-        data = corrupt_and_observe(data, clusters, jones=jt, noise_sigma=0.0)
-        cdata = build_cluster_data(data, clusters, nchunks, fdelta=0.0)
-        ncm = max(nchunks)
-        p0 = jones_to_params(
-            jnp.broadcast_to(identity_jones(6, jnp.complex64),
-                             (2, ncm, 6, 2, 2))
-        )
-        base = dict(max_emiter=2, max_iter=10, max_lbfgs=15,
-                    solver_mode=SM_LM_LBFGS, randomize=False)
-        r_xla = sagefit(data, cdata, p0, SageConfig(**base))
-        r_fus = sagefit(data, cdata, p0,
-                        SageConfig(use_fused_predict=True, **base))
-        assert float(r_fus.res_1) < 0.2 * float(r_fus.res_0)
-        np.testing.assert_allclose(float(r_fus.res_1), float(r_xla.res_1),
-                                   rtol=5e-3, atol=1e-6)
-        np.testing.assert_allclose(np.asarray(r_fus.p), np.asarray(r_xla.p),
-                                   atol=5e-3)
+    f0 = 150e6
+    data = make_visdata(nstations=6, tilesz=2, nchan=1, freq0=f0,
+                        dtype=np.float32, seed=2)
+    clusters = [
+        point_source_batch([0.02], [0.01], [2.0], f0=f0,
+                           dtype=jnp.float32),
+        point_source_batch([-0.01], [0.02], [1.5], f0=f0,
+                           dtype=jnp.float32),
+    ]
+    jt = random_jones(2, 6, seed=3, amp=0.1, dtype=np.complex64)
+    data = corrupt_and_observe(data, clusters, jones=jt, noise_sigma=0.0)
+    cdata = build_cluster_data(data, clusters, nchunks, fdelta=0.0)
+    ncm = max(nchunks)
+    p0 = jones_to_params(
+        jnp.broadcast_to(identity_jones(6, jnp.complex64),
+                         (2, ncm, 6, 2, 2))
+    )
+    base = dict(max_emiter=2, max_iter=10, max_lbfgs=15,
+                solver_mode=SM_LM_LBFGS, randomize=False)
+    r_xla = sagefit(data, cdata, p0, SageConfig(**base))
+    r_fus = sagefit(data, cdata, p0,
+                    SageConfig(use_fused_predict=True, **base))
+    assert float(r_fus.res_1) < 0.2 * float(r_fus.res_0)
+    np.testing.assert_allclose(float(r_fus.res_1), float(r_xla.res_1),
+                               rtol=5e-3, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r_fus.p), np.asarray(r_xla.p),
+                               atol=5e-3)
