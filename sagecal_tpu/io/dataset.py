@@ -316,6 +316,15 @@ class TilePrefetcher:
                 except Exception:
                     continue
             self._thread.join(timeout=5.0)
+            if self._thread.is_alive():
+                # a worker stuck in a long HDF5 read is outliving the
+                # context while holding an open read handle; make that
+                # visible instead of silently leaking the daemon thread
+                import warnings
+                warnings.warn(
+                    f"TilePrefetcher worker for {self._path!r} did not "
+                    "exit within 5 s of context exit; it still holds an "
+                    "open read handle", RuntimeWarning, stacklevel=2)
         return False
 
     def __iter__(self):

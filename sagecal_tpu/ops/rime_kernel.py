@@ -466,6 +466,12 @@ def pack_gain_tables(jones, mp: int):
         M, nc, N = jones.shape[0], jones.shape[1], jones.shape[2]
     else:
         M, nc, N = jones.shape[0], 1, jones.shape[1]
+    if N > NPAD:
+        raise ValueError(
+            f"fused RIME kernel supports at most NPAD={NPAD} stations, "
+            f"got N={N}; use the XLA predict path (or the rows-sharded "
+            f"solver) for larger arrays"
+        )
     flat = jones.reshape(M * nc, N, 4)  # row-major J00, J01, J10, J11
     tab = jnp.transpose(flat, (2, 0, 1))  # (4, M*nc, N)
     tab = jnp.pad(tab, ((0, 0), (0, nc * (mp - M)), (0, NPAD - N)))

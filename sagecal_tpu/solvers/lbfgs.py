@@ -218,11 +218,14 @@ def lbfgs_fit(
         # point y.s can underflow to 0 (or go negative on a noisy
         # Armijo step); storing rho = 1/(y.s) = inf then poisons every
         # later two-loop direction with inf*0 = NaN.  Require
-        # y.s > eps*|y||s| (relative, scale-free) before storing — the
-        # reference never hits this because its solver is f64
-        # throughout (lbfgs.c), where these products stay representable.
+        # y.s > eps*|y||s| (relative, scale-free) before storing, with
+        # eps the machine epsilon OF THE RUNNING DTYPE — so f64 runs
+        # keep reference-equivalent behavior (lbfgs.c stores every
+        # pair; f64 eps only rejects pairs that are non-positive to
+        # machine precision) while f32 stays protected.
         ys = jnp.dot(yk, sk)
-        curv_ok = ys > 1e-7 * jnp.linalg.norm(yk) * jnp.linalg.norm(sk)
+        curv_eps = jnp.finfo(yk.dtype).eps
+        curv_ok = ys > curv_eps * jnp.linalg.norm(yk) * jnp.linalg.norm(sk)
         store = store & curv_ok  # NaN/inf ys already fail curv_ok
         rho_k = jnp.where(curv_ok, 1.0 / jnp.maximum(ys, 1e-38), 0.0)
         slot = mem.vacant

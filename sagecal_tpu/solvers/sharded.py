@@ -34,17 +34,39 @@ from sagecal_tpu.solvers.lbfgs import lbfgs_fit
 from sagecal_tpu.solvers.sage import ClusterData, predict_full_model
 
 
-def _rows_axis_spec(leaf, rows: int, axis_name: str):
-    """PartitionSpec sharding the rows axis.  The canonical layout puts
-    rows MINOR-MOST in every per-row array (core/types.py), so only the
-    last axis is ever a rows axis — matching by position, not by size,
-    avoids mis-sharding when another dimension coincidentally equals
-    the row count."""
-    if not hasattr(leaf, "shape") or leaf.ndim == 0:
-        return P()
+def _row_spec(leaf, name: str, rows: int, axis_name: str):
+    """PartitionSpec sharding the (minor-most) rows axis of a named
+    per-row field.  Specs are built per FIELD NAME, never by matching
+    dimension sizes — a non-row leaf whose last dim coincidentally
+    equals the row count (e.g. ``nchunk`` of shape (M,) when M == rows)
+    must stay replicated or the psum'd cost/grad would be wrong."""
     if leaf.shape[-1] != rows:
-        return P()
+        raise ValueError(
+            f"per-row field {name!r} must be rows-minor with "
+            f"shape[-1]=={rows}, got {leaf.shape}"
+        )
     return P(*([None] * (leaf.ndim - 1)), axis_name)
+
+
+# The per-row fields of each container (rows minor-most, core/types.py).
+# Single source of truth for both sharding specs and row padding.
+_VIS_ROW_FIELDS = ("u", "v", "w", "ant_p", "ant_q", "vis", "mask",
+                   "time_idx")
+_CDATA_ROW_FIELDS = ("coh", "chunk_map")
+
+
+def _build_specs(data: VisData, cdata: ClusterData, rows: int,
+                 axis_name: str):
+    """Spec pytrees for (VisData, ClusterData) with exactly the known
+    per-row fields sharded (``_VIS_ROW_FIELDS`` / ``_CDATA_ROW_FIELDS``).
+    freqs (F,) and nchunk (M,) stay replicated."""
+    data_specs = data.replace(freqs=P(), **{
+        f: _row_spec(getattr(data, f), f, rows, axis_name)
+        for f in _VIS_ROW_FIELDS})
+    cdata_specs = cdata._replace(nchunk=P(), **{
+        f: _row_spec(getattr(cdata, f), f, rows, axis_name)
+        for f in _CDATA_ROW_FIELDS})
+    return data_specs, cdata_specs
 
 
 def pad_rows_to(data: VisData, cdata: ClusterData, mult: int):
@@ -60,15 +82,10 @@ def pad_rows_to(data: VisData, cdata: ClusterData, mult: int):
         cfg = [(0, 0)] * (x.ndim - 1) + [(0, pr)]
         return jnp.pad(x, cfg)
 
-    data = data.replace(
-        u=pad_last(data.u), v=pad_last(data.v), w=pad_last(data.w),
-        ant_p=pad_last(data.ant_p), ant_q=pad_last(data.ant_q),
-        vis=pad_last(data.vis), mask=pad_last(data.mask),
-        time_idx=pad_last(data.time_idx),
-    )
-    cdata = cdata._replace(
-        coh=pad_last(cdata.coh), chunk_map=pad_last(cdata.chunk_map)
-    )
+    data = data.replace(**{
+        f: pad_last(getattr(data, f)) for f in _VIS_ROW_FIELDS})
+    cdata = cdata._replace(**{
+        f: pad_last(getattr(cdata, f)) for f in _CDATA_ROW_FIELDS})
     return data, cdata
 
 
@@ -93,12 +110,7 @@ def sharded_joint_fit(
     assert rows % ndev == 0, (rows, ndev)
     shp = p0.shape
 
-    data_specs = jax.tree.map(
-        lambda leaf: _rows_axis_spec(leaf, rows, axis_name), data
-    )
-    cdata_specs = jax.tree.map(
-        lambda leaf: _rows_axis_spec(leaf, rows, axis_name), cdata
-    )
+    data_specs, cdata_specs = _build_specs(data, cdata, rows, axis_name)
 
     def local_fit(data_l, cdata_l, p0_l):
         def cost_fn(pflat):

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-4 tunnel recovery sequence, ordered per VERDICT.md "Next round" #1:
+#   (a) bank the PLAIN TPU bench first (platform:tpu, north_star_shape:true),
+#   (b) only then run the staged kernel validation (tpu_kernel_check.sh),
+#   (c) if the kernel survives, re-bench with SAGECAL_BENCH_FUSED=1.
+# Probes every ~3 min until the tunnel is healthy; runs the sequence ONCE.
+set -u
+cd /root/repo
+LOG=/root/repo/tpu_watch.log
+probe() {
+  timeout 75 python -c "import jax; print(jax.devices())" 2>/dev/null | grep -q TPU
+}
+DEADLINE=$(( $(date +%s) + 39600 ))   # give up after 11 h
+HEALTHY=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if probe; then
+    echo "TUNNEL HEALTHY at $(date)" >> "$LOG"
+    HEALTHY=1
+    break
+  fi
+  echo "wedged at $(date)" >> "$LOG"
+  sleep 170
+done
+if [ "$HEALTHY" != 1 ]; then
+  echo "GAVE UP (still wedged) at $(date)" >> "$LOG"
+  exit 1
+fi
+
+# (a) bank the plain bench
+echo "=== banking plain TPU bench at $(date)" >> "$LOG"
+timeout 900 python bench.py > /root/repo/bench_tpu_r04.json 2>/root/repo/bench_tpu_r04.err
+if grep -q '"platform": "tpu"' /root/repo/bench_tpu_r04.json && \
+   grep -q '"north_star_shape": true' /root/repo/bench_tpu_r04.json; then
+  echo "BENCH BANKED (tpu, north-star) at $(date)" >> "$LOG"
+else
+  echo "BENCH NOT GREEN at $(date): $(cat /root/repo/bench_tpu_r04.json)" >> "$LOG"
+  exit 2
+fi
+
+# (b) staged kernel validation — stops at first hang, probes between steps
+echo "=== staged kernel check at $(date)" >> "$LOG"
+/root/repo/tpu_kernel_check.sh > /root/repo/tpu_check.out 2>&1
+RC=$?
+echo "kernel check rc=$RC at $(date)" >> "$LOG"
+exit $RC
