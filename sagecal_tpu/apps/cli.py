@@ -91,11 +91,28 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-U", "--spatial-n0", type=int, default=0,
                     help=">0 enables spatial regularization of Z with a "
                     "shapelet basis of this order (ref -U)")
-    ap.add_argument("--spatial-beta", type=float, default=0.01)
+    ap.add_argument("--spatial-beta", type=float, default=0.01,
+                    help="shapelet basis scale; <=0 uses the master's "
+                    "auto scale 4*sqrt(l_max^2/M)")
     ap.add_argument("--spatial-mu", type=float, default=1e-3)
     ap.add_argument("-O", "--spatial-cadence", type=int, default=2,
                     help="run the spatial FISTA update every this many "
                     "ADMM iterations (ref admm_cadence)")
+    ap.add_argument("--spatial-basis", choices=("shapelet", "sharmonic"),
+                    default="shapelet",
+                    help="spatial basis: shapelet(l,m) or spherical-"
+                    "harmonic(r,theta) modes (ref spatialreg_basis)")
+    ap.add_argument("--spatial-diffuse-id", type=int, default=None,
+                    help="cluster id of the all-shapelet diffuse cluster "
+                    "to constrain/re-predict from the spatial model "
+                    "(ref sp_diffuse_id)")
+    ap.add_argument("--spatial-gamma", type=float, default=0.1,
+                    help="diffuse-constraint coupling (ref sp_gamma)")
+    ap.add_argument("--spatial-lam", type=float, default=1e-3,
+                    help="diffuse-constraint L2 (ref sh_lambda)")
+    ap.add_argument("--mdl", action="store_true",
+                    help="score consensus polynomial orders by AIC/MDL "
+                    "each tile (ref master -M, mdl.c)")
     ap.add_argument("-i", "--influence", action="store_true",
                     help="write influence-function diagnostics instead of "
                     "residuals (ref -i)")
@@ -174,6 +191,11 @@ def main(argv=None):
             spatial_beta=args.spatial_beta,
             spatial_mu=args.spatial_mu,
             spatial_cadence=args.spatial_cadence,
+            spatial_basis=args.spatial_basis,
+            spatial_diffuse_id=args.spatial_diffuse_id,
+            spatial_gamma=args.spatial_gamma,
+            spatial_lam=args.spatial_lam,
+            mdl=args.mdl,
         )
     elif cfg.epochs > 0:
         from sagecal_tpu.apps.minibatch import run_minibatch

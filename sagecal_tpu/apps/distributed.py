@@ -105,6 +105,11 @@ def run_distributed(
     spatial_mu: float = 1e-3,
     spatial_alpha: float = 0.0,
     spatial_cadence: int = 2,
+    spatial_basis: str = "shapelet",
+    spatial_diffuse_id: Optional[int] = None,
+    spatial_gamma: float = 0.0,
+    spatial_lam: float = 0.0,
+    mdl: bool = False,
 ):
     """Calibrate a multi-band observation on the device mesh.
 
@@ -114,7 +119,22 @@ def run_distributed(
     (dual_res, primal_res) traces.
 
     ``spatial_n0 > 0`` switches on spatial regularization inside the
-    ADMM loop (shapelet basis of order n0, the master's -U path).
+    ADMM loop (the master's -U path); ``spatial_basis`` selects
+    shapelet or spherical-harmonic modes (master:359-397);
+    ``spatial_beta <= 0`` uses the master's auto scale.
+
+    ``spatial_diffuse_id``: cluster id whose (all-shapelet) coherencies
+    are re-predicted from the diffuse-constrained spatial model — the
+    find_initial_spatial / Zspat_diff / Psi chain (master:649-926,
+    slave:670-698) with ``spatial_gamma``/``spatial_lam`` as
+    (sp_gamma, sh_lambda).  The refresh runs between tiles (the
+    reference refreshes every admm_cadence iterations inside the loop;
+    we keep the whole Nadmm loop in one jit program and apply the
+    refreshed coherencies to the next tile).
+
+    ``mdl=True`` scores consensus polynomial orders 1..Npoly by
+    AIC/MDL on each tile's rho-scaled solutions and logs the winner
+    (the master's -M path, sagecal_master.cpp:991-993).
     """
     if multihost:
         jax.distributed.initialize()
@@ -131,7 +151,8 @@ def run_distributed(
         return _run_distributed_inner(
             cfg, datasets, handles, open_files, log, nadmm, dtype,
             spatial_n0, spatial_beta, spatial_mu, spatial_alpha,
-            spatial_cadence,
+            spatial_cadence, spatial_basis, spatial_diffuse_id,
+            spatial_gamma, spatial_lam, mdl,
         )
     finally:
         for fh in open_files:
@@ -149,6 +170,8 @@ def run_distributed(
 def _run_distributed_inner(
     cfg, datasets, handles, open_files, log, nadmm, dtype,
     spatial_n0, spatial_beta, spatial_mu, spatial_alpha, spatial_cadence,
+    spatial_basis="shapelet", spatial_diffuse_id=None, spatial_gamma=0.0,
+    spatial_lam=0.0, mdl=False,
 ):
     metas = [h.meta for h in handles]
     ntime = _check_band_consistency(metas, log)
@@ -157,7 +180,7 @@ def _run_distributed_inner(
     freqs = np.asarray([m.freq0 for m in metas])
     freq0 = float(np.mean(freqs))
 
-    clusters, cdefs = load_sky(
+    clusters, cdefs, shapelets = load_sky(
         cfg.sky_model, cfg.cluster_file, meta0.ra0, meta0.dec0, dtype=dtype
     )
     M = len(clusters)
@@ -189,8 +212,13 @@ def _run_distributed_inner(
     ) if Nf_pad != Nf else B
 
     spatial = None
+    diffuse_idx = None
+    diffuse_beta = None
     if spatial_n0 > 0:
-        from sagecal_tpu.parallel.spatial import build_spatial_basis, phikk_matrix
+        from sagecal_tpu.parallel.spatial import (
+            basis_blocks, find_initial_spatial, phikk_matrix,
+            spatial_basis_modes,
+        )
 
         # flux-weighted cluster centroids (the master's spatial-basis
         # setup computes these from the sky model, :293-423)
@@ -207,13 +235,38 @@ def _run_distributed_inner(
         # effective clusters repeat their centroid per hybrid chunk
         lle = np.repeat(lls, nchunk_max)
         mme = np.repeat(mms, nchunk_max)
-        Phi = build_spatial_basis(lle, mme, n0=spatial_n0, beta=spatial_beta)
+        sp_modes, beta_used = spatial_basis_modes(
+            lle, mme, spatial_n0,
+            None if spatial_beta <= 0 else spatial_beta, spatial_basis,
+        )
+        diffuse_beta = beta_used if beta_used > 0 else spatial_beta
+        log(f"spatial basis {spatial_basis} n0={spatial_n0} "
+            f"beta={beta_used:.4g}")
+        Phi = basis_blocks(sp_modes)
+        Z_diff0 = None
+        if spatial_diffuse_id is not None:
+            if spatial_basis != "shapelet":
+                raise ValueError(
+                    "the diffuse constraint re-predicts coherencies "
+                    "through SHAPELET products (diffuse_predict.c); use "
+                    "--spatial-basis shapelet with --spatial-diffuse-id"
+                )
+            # diffuse target: cluster id -> index; must be all-shapelet
+            ids = [cd.cluster_id for cd in cdefs]
+            if spatial_diffuse_id not in ids:
+                raise ValueError(
+                    f"diffuse cluster id {spatial_diffuse_id} not in "
+                    f"cluster file (ids {ids})"
+                )
+            diffuse_idx = ids.index(spatial_diffuse_id)
+            Z_diff0 = find_initial_spatial(B, sp_modes, N)
         spatial = SpatialConfig(
             Phi=Phi, Phikk=phikk_matrix(Phi, lam=1e-6),
             alpha=jnp.asarray(
                 np.where(alpha_m > 0, alpha_m, cfg.admm_rho), dtype
             ),
             mu=spatial_mu, cadence=spatial_cadence,
+            Z_diff0=Z_diff0, gamma=spatial_gamma, lam_diff=spatial_lam,
         )
 
     fn = make_admm_mesh_fn(
@@ -263,11 +316,20 @@ def _run_distributed_inner(
         TilePrefetcher(path, full_t0s, spec, cfg.tilesz, depth=1)
         for path in datasets
     ]
-    pf_iters = []
-    try:
-      pf_iters = [iter(pf.__enter__()) for pf in prefetchers]
-      for tile_no, t0 in pairs:
-        tic = time.time()
+    from sagecal_tpu.utils.profiling import PhaseTimer
+
+    timer = PhaseTimer()
+
+    def _prepare_tile(t0, zdiff):
+        """Load + precompute one tile's per-band arrays.  All device
+        work here is ASYNC-dispatched jit (JAX returns before compute
+        finishes), so calling this between dispatching tile t's solve
+        and blocking on its outputs overlaps the coherency precompute
+        with the device solve — the role of the reference's per-tile
+        threaded precalculate_coherencies (fullbatch_mode.cpp:371-388)
+        without a host thread pool.  ``zdiff`` may be a LAZY device
+        array from the in-flight solve (the diffuse chain stays on
+        device, no sync)."""
         datas, cdatas, fratios = [], [], []
         # clamp the tile to the COMMON timeslot range so bands with more
         # timeslots than ntime_min still produce equal row counts on the
@@ -290,26 +352,92 @@ def _run_distributed_inner(
             # frequency; freq0/deltaf statics only matter pre-stack)
             d = d.replace(freq0=freq0, deltaf=meta0.deltaf)
             datas.append(d)
-            cdatas.append(build_cluster_data(d, clusters, nchunks))
-            fratios.append(float(jnp.mean(d.mask)))
+            cdata_b = build_cluster_data(d, clusters, nchunks,
+                                         shapelets=shapelets)
+            if diffuse_idx is not None and zdiff is not None:
+                # re-predict the diffuse cluster from the previous
+                # tile's diffuse-constrained spatial model
+                # (slave:670-698; between-tiles by design, see
+                # run_distributed docstring)
+                from sagecal_tpu.ops.diffuse import (
+                    recalculate_diffuse_coherencies,
+                )
+                from sagecal_tpu.parallel.spatial import bz_spatial
+
+                Zb = bz_spatial(zdiff, B_pad[bi], N)
+                cdata_b = recalculate_diffuse_coherencies(
+                    d, cdata_b, diffuse_idx, clusters[diffuse_idx],
+                    shapelets, Zb, spatial_n0, diffuse_beta,
+                )
+            cdatas.append(cdata_b)
+            # LAZY unflagged fraction: a host float() here would block
+            # behind the in-flight tile-t solve on an in-order device
+            # stream, serializing 'prepare' after the solve; the sync
+            # happens at the NEXT dispatch when the queue is free
+            fratios.append(jnp.mean(d.mask))
         # zero-weight padding bands: replicate band 0 with mask 0
         for _ in range(Nf_pad - Nf):
             dpad = datas[0].replace(mask=jnp.zeros_like(datas[0].mask))
             datas.append(dpad)
             cdatas.append(cdatas[0])
-            fratios.append(0.0)
+            fratios.append(jnp.zeros(()))
+        return datas, cdatas, fratios
+
+    pf_iters = []
+    zdiff_carry = None
+    try:
+      pf_iters = [iter(pf.__enter__()) for pf in prefetchers]
+      prepared = None
+      if pairs:
+        with timer.phase("prepare"):
+            prepared = _prepare_tile(pairs[0][1], None)
+      for pi, (tile_no, t0) in enumerate(pairs):
+        tic = time.time()
+        datas, cdatas, fratios_lazy = prepared
+        # sync the lazy per-band unflagged fractions NOW (the previous
+        # tile's solve has been consumed, the queue is free)
+        fratios = [float(np.asarray(f)) for f in fratios_lazy]
         # rho scaled by each band's unflagged fraction (master :709-723)
         rho = jnp.asarray(
             np.asarray(fratios)[:, None] * rho_m[None, :], dtype
         )
-        out = fn(
-            stack_for_mesh(datas), stack_for_mesh(cdatas),
-            p_bands, rho, jnp.asarray(B_pad, dtype),
-        )
+        with timer.phase("dispatch"):
+            out = fn(
+                stack_for_mesh(datas), stack_for_mesh(cdatas),
+                p_bands, rho, jnp.asarray(B_pad, dtype),
+            )
         p_bands = out.p  # warm start the next tile (reference keeps p)
-        append_global_z(zfh, out.Z, N, cfg.npoly, nchunk_max)
-        zfh.flush()
-        for i in range(Nf):
+        if diffuse_idx is not None:
+            zdiff_carry = out.Zspat_diff  # lazy device array, no sync
+        # overlap: prepare tile t+1 (I/O + coherency dispatch) while
+        # the mesh solves tile t on device
+        if pi + 1 < len(pairs):
+            with timer.phase("prepare"):
+                prepared = _prepare_tile(pairs[pi + 1][1], zdiff_carry)
+        if mdl:
+            # AIC/MDL consensus-order scan on this tile's rho-scaled
+            # solutions (the master's -M path at admm==0,
+            # sagecal_master.cpp:986-993)
+            from sagecal_tpu.parallel.spatial import (
+                minimum_description_length,
+            )
+
+            w = np.asarray(fratios[:Nf])
+            Jst = (
+                np.asarray(out.p[:Nf], np.float64).reshape(Nf, M, -1)
+                * w[:, None, None] * np.asarray(rho_m)[None, :, None]
+            )
+            aic, mdl_s, k_aic, k_mdl = minimum_description_length(
+                Jst, rho_m, freqs, freq0, weight=w,
+                Kstart=1, Kfinish=max(cfg.npoly, 2),
+            )
+            log(f"tile {t0} MDL: best order AIC={k_aic} MDL={k_mdl} "
+                f"(aic {np.array2string(aic, precision=2)}, "
+                f"mdl {np.array2string(mdl_s, precision=2)})")
+        with timer.phase("solve-wait+write"):
+          append_global_z(zfh, out.Z, N, cfg.npoly, nchunk_max)
+          zfh.flush()
+          for i in range(Nf):
             jsol = np.asarray(params_to_jones(out.p[i])).reshape(
                 M * nchunk_max, N, 2, 2
             )
@@ -325,8 +453,10 @@ def _run_distributed_inner(
         )
         log(
             f"tile {t0}: dual {float(out.dual_res[-1]):.3e} primal "
-            f"{float(out.primal_res[-1]):.3e} ({time.time()-tic:.1f}s)"
+            f"{float(out.primal_res[-1]):.3e} ({time.time()-tic:.1f}s) "
+            f"[{timer.tile_summary()}]"
         )
+      log(f"phases: {timer.run_summary()}")
     finally:
         # reap every band's prefetch thread even on a mid-loop failure
         for pf in prefetchers:

@@ -106,7 +106,7 @@ def run_fullbatch(cfg: RunConfig, log=print):
     cdtype = np.complex128 if cfg.use_f64 else np.complex64
     ds = VisDataset(cfg.dataset, "r+")
     meta = ds.meta
-    clusters, cdefs = load_sky(
+    clusters, cdefs, shapelets = load_sky(
         cfg.sky_model, cfg.cluster_file, meta.ra0, meta.dec0, dtype=dtype
     )
     M = len(clusters)
@@ -153,12 +153,13 @@ def run_fullbatch(cfg: RunConfig, log=print):
         """Cluster coherencies, beam-aware when -B is on
         (fullbatch_mode.cpp:371-388 dispatch)."""
         if beam is None:
-            return build_cluster_data(dat, clusters, nchunks, fdelta=fdelta)
+            return build_cluster_data(dat, clusters, nchunks, fdelta=fdelta,
+                                      shapelets=shapelets)
         geom, pointing, coeff, mode, wideband = beam
         return build_cluster_data_withbeam(
             dat, clusters, nchunks, geom, pointing, coeff, mode,
             ds.time_jd(t0, dat.tilesz), meta.ra0, meta.dec0,
-            fdelta=fdelta, wideband=wideband,
+            fdelta=fdelta, wideband=wideband, shapelets=shapelets,
         )
 
     # first-class profiling (SURVEY section 5): per-phase wall-clock

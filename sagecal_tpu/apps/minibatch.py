@@ -58,7 +58,7 @@ def run_minibatch(cfg: RunConfig, log=print):
     cdtype = np.complex128 if cfg.use_f64 else np.complex64
     ds = VisDataset(cfg.dataset, "r+")
     meta = ds.meta
-    clusters, cdefs = load_sky(
+    clusters, cdefs, shapelets = load_sky(
         cfg.sky_model, cfg.cluster_file, meta.ra0, meta.dec0, dtype=dtype
     )
     M = len(clusters)
@@ -133,7 +133,8 @@ def run_minibatch(cfg: RunConfig, log=print):
             if not consensus_mode:
                 for bi, (c0, c1) in enumerate(bands):
                     db = _band_visdata(full, c0, c1)
-                    cb = build_cluster_data(db, clusters, nchunks, fdelta=fd)
+                    cb = build_cluster_data(db, clusters, nchunks, fdelta=fd,
+                            shapelets=shapelets)
                     p_bands[bi], mem_bands[bi] = solve_band(bi, db, cb)
             else:
                 # band ADMM within this minibatch
@@ -143,7 +144,8 @@ def run_minibatch(cfg: RunConfig, log=print):
                     db = _band_visdata(full, c0, c1)
                     dbs.append(db)
                     cbs.append(build_cluster_data(db, clusters, nchunks,
-                                                  fdelta=fd))
+                                                  fdelta=fd,
+                                                  shapelets=shapelets))
                 for admm in range(cfg.admm_iters):
                     zacc = jnp.zeros((M, cfg.npoly, nchunk_max * 8 * N), dtype)
                     for bi in range(len(bands)):
@@ -201,7 +203,8 @@ def run_minibatch(cfg: RunConfig, log=print):
         res_all = np.array(np.asarray(mat_of_flat(full.vis)), copy=True)
         for bi, (c0, c1) in enumerate(bands):
             db = _band_visdata(full, c0, c1)
-            cb = build_cluster_data(db, clusters, nchunks, fdelta=fd)
+            cb = build_cluster_data(db, clusters, nchunks, fdelta=fd,
+                            shapelets=shapelets)
             res = calculate_residuals(db, cb, p_bands[bi])
             res_all[:, c0:c1] = np.asarray(mat_of_flat(res))
             acc[bi][0] += float(jnp.sum(jnp.abs(db.vis) ** 2))

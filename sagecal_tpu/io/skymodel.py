@@ -247,18 +247,74 @@ def load_sky(
     ra0: float,
     dec0: float,
     dtype=np.float32,
-) -> tuple[list, list]:
-    """Full pipeline: files -> ([SourceBatch per cluster], [ClusterDef])."""
+) -> tuple[list, list, object]:
+    """Full pipeline: files ->
+    ([SourceBatch per cluster], [ClusterDef], ShapeletTable | None).
+
+    Shapelet (S-type) sources additionally load their
+    ``<name>.fits.modes`` file from the sky file's directory
+    (readsky.c:143-200) into ONE sky-global :class:`ShapeletTable`;
+    each batch's ``shapelet_idx`` is remapped from cluster-local to
+    global rows.  Returns None for the table when the sky has no
+    shapelet sources."""
+    import jax.numpy as jnp
+
+    from sagecal_tpu.ops.rime import ST_SHAPELET
+
     sky = parse_skymodel(sky_path)
     cdefs = parse_clusters(cluster_path)
+    directory = os.path.dirname(os.path.abspath(sky_path))
     batches = []
+    shap_entries = []  # (n0, beta, modes, eX, eY, eP) in global order
     for cd in cdefs:
         srcs = [sky[n] for n in cd.source_names if n in sky]
         missing = [n for n in cd.source_names if n not in sky]
         if missing:
             raise ValueError(f"cluster {cd.cluster_id}: unknown sources {missing}")
-        batches.append(build_source_batch(srcs, ra0, dec0, dtype))
-    return batches, cdefs
+        batch = build_source_batch(srcs, ra0, dec0, dtype)
+        stype_np = np.asarray(batch.stype)
+        shap_srcs = [s for i, s in enumerate(srcs)
+                     if int(stype_np[i]) == ST_SHAPELET]
+        if shap_srcs:
+            offset = len(shap_entries)
+            for s in shap_srcs:
+                n0, beta, modes = read_shapelet_modes(s.name, directory)
+                shap_entries.append(
+                    (n0, beta, modes, s.eX or 1.0, s.eY or 1.0, s.eP)
+                )
+            idx = np.asarray(batch.shapelet_idx)
+            batch = batch.replace(shapelet_idx=jnp.asarray(
+                np.where(idx >= 0, idx + offset, -1), np.int32))
+        batches.append(batch)
+    tab = build_shapelet_table(shap_entries, dtype) if shap_entries else None
+    return batches, cdefs, tab
+
+
+def build_shapelet_table(entries, dtype=np.float32):
+    """Assemble a global :class:`ShapeletTable` from
+    ``(n0, beta, modes, eX, eY, eP)`` tuples.  Models with n0 < n0max
+    zero-pad their (n2, n1) mode grid — exact, since unused basis
+    coefficients contribute nothing (mode (n1, n2) lives at flat index
+    n2*n0 + n1, ops/shapelets.uv_mode_vectors)."""
+    import jax.numpy as jnp
+
+    from sagecal_tpu.ops.rime import ShapeletTable
+
+    n0max = max(e[0] for e in entries)
+    K = len(entries)
+    modes = np.zeros((K, n0max * n0max))
+    beta = np.empty(K)
+    eX = np.empty(K)
+    eY = np.empty(K)
+    eP = np.empty(K)
+    for k, (n0, b, m, ex, ey, ep) in enumerate(entries):
+        grid = np.zeros((n0max, n0max))
+        grid[:n0, :n0] = np.asarray(m).reshape(n0, n0)  # (n2, n1)
+        modes[k] = grid.reshape(-1)
+        beta[k], eX[k], eY[k], eP[k] = b, ex, ey, ep
+    cast = lambda x: jnp.asarray(x, dtype)
+    return ShapeletTable(modes=cast(modes), beta=cast(beta), eX=cast(eX),
+                         eY=cast(eY), eP=cast(eP), n0max=int(n0max))
 
 
 def read_cluster_rho(

@@ -174,3 +174,146 @@ def make_federated_mesh_fn(
         return FederatedResult(p=p, Z=Z, dual_res=dres)
 
     return fn
+
+
+class FederatedState(NamedTuple):
+    """Carried state of the stochastic federated mode — every leaf has a
+    leading band axis (Nf,) sharded over the mesh.  The pytree analog of
+    the stochastic slave's Z/Zavg/X/Y/pfreq/persistent-LBFGS allocations
+    (sagecal_stochastic_slave.cpp:441-470, 637-638)."""
+
+    p: jax.Array       # (Nf, M, nchunk_max, 8N) per-band solutions
+    Y: jax.Array       # (Nf, M, nchunk_max, 8N) consensus duals
+    Z: jax.Array       # (Nf, M, Npoly, K) per-band local consensus
+    Zbar: jax.Array    # (Nf, M, Npoly, K) federated average (per frame)
+    X: jax.Array       # (Nf, M, Npoly, K) federation duals
+    mem: object        # LBFGSMemory with (Nf,)-leading leaves
+
+
+def init_federated_state(Nf, M, nchunk_max, n8, npoly, lbfgs_m, dtype):
+    from sagecal_tpu.solvers.lbfgs import LBFGSMemory
+
+    K = nchunk_max * n8
+    zeros_p = jnp.zeros((Nf, M, nchunk_max, n8), dtype)
+    zeros_z = jnp.zeros((Nf, M, npoly, K), dtype)
+    mem1 = LBFGSMemory.init(M * K, lbfgs_m, dtype)
+    mem = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (Nf,) + x.shape).copy(), mem1
+    )
+    from sagecal_tpu.core.types import identity_jones, jones_to_params
+
+    N = n8 // 8
+    eye = jones_to_params(identity_jones(
+        N, jnp.complex64 if dtype == jnp.float32 else jnp.complex128))
+    p0 = jnp.broadcast_to(eye, (Nf, M, nchunk_max, n8)).astype(dtype)
+    return FederatedState(p=p0, Y=zeros_p, Z=zeros_z, Zbar=zeros_z,
+                          X=zeros_z, mem=mem)
+
+
+def make_federated_minibatch_fn(
+    mesh: Mesh,
+    axis_name: str = "freq",
+    itmax: int = 10,
+    lbfgs_m: int = 7,
+    alpha: float = 1.0,
+    robust_nu=None,
+):
+    """One federated-stochastic minibatch round as a jitted mesh
+    program: per band, the consensus minibatch LBFGS x-step with
+    PERSISTENT memory (bfgsfit_minibatch_consensus,
+    robust_batchmode_lbfgs.c:1504), Y ascent, and the local federated
+    z-step z = pinv(rho B B^T + alpha I)(B(Y + rho J) + alpha Zbar - X)
+    (stochastic_slave.cpp:756-850).  The federated average itself is
+    :func:`make_fed_avg_fn` — called at the reference's cadence (after
+    each epoch block, :856-860), not per minibatch.
+
+    fn(data_stack, cdata_stack, state, rho (Nf, M), B (Nf, Npoly))
+      -> (state, dual_res (replicated), data_cost (Nf,))
+    """
+    from sagecal_tpu.solvers.batchmode import bfgsfit_minibatch_consensus
+
+    def local_step(data, cdata, st, rho, B_f):
+        M, nchunk_max, n8 = st.p.shape
+        K = nchunk_max * n8
+        Npoly = B_f.shape[0]
+        dtype = st.p.dtype
+        alpha_v = jnp.full((M,), alpha, dtype)
+
+        BZ = _unflat(consensus.bz_for_freq(st.Z, B_f), nchunk_max, n8)
+        p1, mem1 = bfgsfit_minibatch_consensus(
+            data, cdata, st.p, st.Y, BZ, rho, memory=st.mem,
+            itmax=itmax, lbfgs_m=lbfgs_m, robust_nu=robust_nu,
+        )
+        Yhat = st.Y + rho[:, None, None] * p1
+
+        P_loc = jnp.einsum("m,p,q->mpq", rho, B_f, B_f)
+        P_loc = P_loc + alpha_v[:, None, None] * jnp.eye(
+            Npoly, dtype=dtype)[None]
+        Bii = jnp.linalg.pinv(P_loc)
+        z = consensus.accumulate_z_term(B_f, _flat(Yhat))
+        z = z + alpha_v[:, None, None] * st.Zbar - st.X
+        Z1 = consensus.update_global_z(z, Bii)
+
+        BZ1 = _unflat(consensus.bz_for_freq(Z1, B_f), nchunk_max, n8)
+        Y1 = Yhat - rho[:, None, None] * BZ1
+        dres = jax.lax.pmean(
+            consensus.admm_dual_residual(Z1, st.Z), axis_name
+        )
+        from sagecal_tpu.solvers.batchmode import _data_cost
+
+        cost = _data_cost(p1.reshape(-1), data, cdata,
+                          (M, nchunk_max, n8), robust_nu)
+        st1 = st._replace(p=p1, Y=Y1, Z=Z1, mem=mem1)
+        # re-add the local (length-1) band axis for the fspec outputs
+        st1 = jax.tree_util.tree_map(lambda x: x[None], st1)
+        return st1, dres, cost[None]
+
+    fspec = P(axis_name)
+    rspec = P()
+
+    @jax.jit
+    def fn(data_stack, cdata_stack, state, rho, B):
+        sm = jax.shard_map(
+            lambda d, c, s, r, b: local_step(
+                jax.tree_util.tree_map(lambda x: x[0], d),
+                jax.tree_util.tree_map(lambda x: x[0], c),
+                jax.tree_util.tree_map(lambda x: x[0], s),
+                r[0], b[0],
+            ),
+            mesh=mesh,
+            in_specs=(fspec, fspec, fspec, fspec, fspec),
+            out_specs=(fspec, rspec, fspec),
+            check_vma=True,
+        )
+        st_l, dres, cost = sm(data_stack, cdata_stack, state, rho, B)
+        # shard_map strips/re-adds the band axis; state leaves keep (Nf,)
+        return st_l, dres, cost
+
+    return fn
+
+
+def make_fed_avg_fn(mesh: Mesh, axis_name: str = "freq",
+                    alpha: float = 1.0, niter: int = 10):
+    """Federated averaging round: Zbar <- manifold average of all bands'
+    Z projected back per frame; X <- X + alpha (Z - Zbar)
+    (stochastic_master.cpp:347, slave:856-868)."""
+
+    fspec = P(axis_name)
+
+    def local(st):
+        st0 = jax.tree_util.tree_map(lambda x: x[0], st)
+        M = st0.Z.shape[0]
+        alpha_v = jnp.asarray(alpha, st0.Z.dtype)
+        Zbar = _fed_zavg(st0.Z, axis_name, niter=niter)
+        X1 = st0.X + alpha_v * (st0.Z - Zbar)
+        st1 = st0._replace(Zbar=Zbar, X=X1)
+        return jax.tree_util.tree_map(lambda x: x[None], st1)
+
+    @jax.jit
+    def fn(state):
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=(fspec,), out_specs=fspec,
+            check_vma=True,
+        )(state)
+
+    return fn

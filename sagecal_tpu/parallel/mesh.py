@@ -70,6 +70,7 @@ class AdmmResult(NamedTuple):
     primal_res: jax.Array  # (nadmm,) mean primal residual ||J - BZ||
     Zspat: Optional[jax.Array] = None  # (2*Npoly*N*nchunk?, 2G) spatial model
     spat_res: Optional[jax.Array] = None  # (nadmm,) ||Z - Zbar|| trace
+    Zspat_diff: Optional[jax.Array] = None  # (D, 2G) diffuse-constraint model
 
 
 class SpatialConfig(NamedTuple):
@@ -83,6 +84,16 @@ class SpatialConfig(NamedTuple):
       alpha column);
     mu: L1 strength; cadence: run the FISTA update every this many ADMM
     iterations (-O admm_cadence); fista_maxiter: inner FISTA steps.
+
+    Diffuse-sky constraint (sagecal_master.cpp:908-926, fista.c:131):
+    when ``Z_diff0`` is given (the ``find_initial_spatial`` model), the
+    FISTA step carries the extra term Psi^H(Zs - Zdiff) +
+    gamma/2 ||Zs - Zdiff||^2, and each cadence also updates
+      Zdiff <- (Zdiff0 + 0.5 Psi + 0.5 gamma Zs) / (1 + 0.5 gamma + lam_diff)
+      Psi   <- Psi + gamma (Zs - Zdiff)
+    The resulting Zdiff (AdmmResult.Zspat_diff) is what the diffuse
+    cluster's coherencies are re-predicted from (sagecal_slave.cpp:670,
+    ops/diffuse.recalculate_diffuse_coherencies).
     """
 
     Phi: jax.Array
@@ -91,6 +102,9 @@ class SpatialConfig(NamedTuple):
     mu: float = 1e-3
     cadence: int = 2
     fista_maxiter: int = 30
+    Z_diff0: Optional[jax.Array] = None
+    gamma: float = 0.0
+    lam_diff: float = 0.0
 
 
 def _flat(x):
@@ -233,9 +247,13 @@ def make_admm_mesh_fn(
             Zspat0 = jnp.zeros((D, twoG), jnp.complex64 if p0.dtype == jnp.float32
                                else jnp.complex128)
             alpha_sp = spatial.alpha.astype(p0.dtype)
+            use_diff = spatial.Z_diff0 is not None
+            if use_diff:
+                Zdiff0_c = jnp.asarray(spatial.Z_diff0, Zspat0.dtype)
 
-            def spatial_update(Z, Xsp):
-                """FISTA re-fit + Zbar/X updates (cadenced)."""
+            def spatial_update(Z, Xsp, Zdiff, Psi):
+                """FISTA re-fit + Zbar/X updates (cadenced), optionally
+                with the diffuse constraint (master:908-926)."""
                 from sagecal_tpu.parallel.spatial import (
                     spatial_model_apply, update_spatialreg_fista,
                 )
@@ -245,7 +263,17 @@ def make_admm_mesh_fn(
                     Zbar_c, spatial.Phikk.astype(Zspat0.dtype),
                     spatial.Phi.astype(Zspat0.dtype),
                     spatial.mu, maxiter=spatial.fista_maxiter,
+                    Z_diff=Zdiff if use_diff else None,
+                    Psi=Psi if use_diff else None,
+                    gamma=spatial.gamma if use_diff else 0.0,
                 )
+                if use_diff:
+                    # Zdiff prox + Psi ascent (master:919-926)
+                    g = spatial.gamma
+                    Zdiff = (Zdiff0_c + 0.5 * Psi + 0.5 * g * Zs) / (
+                        1.0 + 0.5 * g + spatial.lam_diff
+                    )
+                    Psi = Psi + g * (Zs - Zdiff)
                 Zbar_new_c = spatial_model_apply(Zs, spatial.Phi.astype(Zs.dtype))
                 Zbar_new = _z_of_zbar_blocks(
                     Zbar_new_c, M_, B_g.shape[-1], nchunk_max, n8
@@ -253,7 +281,7 @@ def make_admm_mesh_fn(
                 Zerr = Z - Zbar_new
                 Xsp_new = Xsp + alpha_sp[:, None, None] * Zerr
                 sres = jnp.linalg.norm(Zerr.ravel()) / Zerr.size
-                return Zbar_new, Xsp_new, Zs, sres
+                return Zbar_new, Xsp_new, Zs, sres, Zdiff, Psi
 
         def bz_of(Z_, g):
             return _unflat(
@@ -285,7 +313,7 @@ def make_admm_mesh_fn(
             p1 = p.at[g].set(p1_g)
             Yhat_all1 = Yhat_all.at[g].set(Yhat_g)
             if use_spatial:
-                Zbar_flat, Xsp, Zs_c, _ = spstate
+                Zbar_flat, Xsp = spstate[0], spstate[1]
                 z_extra = alpha_sp[:, None, None] * Zbar_flat - Xsp
                 Z1 = _zstep_grouped(
                     _flat(Yhat_all1), rho, B_g, axis_name,
@@ -295,7 +323,9 @@ def make_admm_mesh_fn(
                 do_sp = (it % spatial.cadence) == 0
                 spstate1 = jax.lax.cond(
                     do_sp,
-                    lambda args: spatial_update(args[0], args[1][1]),
+                    lambda args: spatial_update(
+                        args[0], args[1][1], args[1][4], args[1][5]
+                    ),
                     lambda args: args[1],
                     (Z1, spstate),
                 )
@@ -331,7 +361,9 @@ def make_admm_mesh_fn(
             )
 
         spstate0 = (
-            (Zbar_flat0, Xsp0, Zspat0, jnp.zeros((), p0.dtype))
+            (Zbar_flat0, Xsp0, Zspat0, jnp.zeros((), p0.dtype),
+             Zdiff0_c if use_spatial and use_diff else Zspat0,
+             jnp.zeros_like(Zspat0))
             if use_spatial
             else jnp.zeros((), p0.dtype)
         )
@@ -343,7 +375,11 @@ def make_admm_mesh_fn(
         pres = jnp.concatenate([jnp.zeros((1,), pres.dtype), pres])
         sres = jnp.concatenate([jnp.zeros((1,), sres.dtype), sres])
         Zspat_out = spstate[2] if use_spatial else jnp.zeros((1, 1), jnp.complex64)
-        return p, Y, Z, rho, dres, pres, Zspat_out, sres
+        Zdiff_out = (
+            spstate[4] if use_spatial and use_diff
+            else jnp.zeros((1, 1), jnp.complex64)
+        )
+        return p, Y, Z, rho, dres, pres, Zspat_out, sres, Zdiff_out
 
     fspec = P(axis_name)
     rspec = P()
@@ -362,15 +398,16 @@ def make_admm_mesh_fn(
             local_loop,
             mesh=mesh,
             in_specs=(fspec, fspec, fspec, fspec, fspec),
-            out_specs=(fspec, fspec, rspec, fspec, rspec, rspec, rspec, rspec),
+            out_specs=(fspec, fspec, rspec, fspec, rspec, rspec, rspec,
+                       rspec, rspec),
             check_vma=True,
         )
-        p, Y, Z, rho_f, dres, pres, Zspat, sres = sm(
+        p, Y, Z, rho_f, dres, pres, Zspat, sres, Zdiff = sm(
             data_stack, cdata_stack, p0, rho, B
         )
         return AdmmResult(
             p=p, Y=Y, Z=Z, rho=rho_f, dual_res=dres, primal_res=pres,
-            Zspat=Zspat, spat_res=sres,
+            Zspat=Zspat, spat_res=sres, Zspat_diff=Zdiff,
         )
 
     return fn

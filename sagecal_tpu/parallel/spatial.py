@@ -15,6 +15,7 @@ Conventions (fista.c:20-36):
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple
 
 import jax
@@ -27,18 +28,113 @@ FISTA_L_MIN = 1e-9
 FISTA_L_MAX = 1e9
 
 
-def build_spatial_basis(ll, mm, n0: int, beta: float):
-    """Per-cluster spatial basis blocks Phi: (M, 2G, 2), G = n0*n0,
-    from shapelet image-plane modes evaluated at the cluster centroids
-    (the master's basis setup, sagecal_master.cpp:293-423):
-    Phi_k = kron(phi(l_k, m_k), I_2)."""
+def _assoc_legendre(l: int, m: int, x):
+    """Associated Legendre P_l^m(x) with the Condon-Shortley phase, by
+    the standard recurrence (elementbeam.c:560-588 ``P``)."""
+    x = np.asarray(x, np.float64)
+    pmm = np.ones_like(x)
+    if m > 0:
+        somx2 = np.sqrt((1.0 - x) * (1.0 + x))
+        fact = 1.0
+        for _ in range(1, m + 1):
+            pmm = pmm * (-fact) * somx2
+            fact += 2.0
+    if l == m:
+        return pmm
+    pmmp1 = x * (2.0 * m + 1.0) * pmm
+    if l == m + 1:
+        return pmmp1
+    pll = pmm
+    for i in range(m + 2, l + 1):
+        pll = ((2.0 * i - 1.0) * x * pmmp1 - (i + m - 1.0) * pmm) / (i - m)
+        pmm = pmmp1
+        pmmp1 = pll
+    return pll
+
+
+def sharmonic_mode_matrix(theta, phi, n0: int) -> np.ndarray:
+    """Spherical-harmonic basis (Nt, n0^2) complex — one mode vector per
+    (theta, phi) point (``sharmonic_modes``, elementbeam.c:600-816 /
+    Dirac_radio.h:376).
+
+    Mode order: l = 0..n0-1, then m = -l..l (negative m stored as the
+    conjugate of the +|m| mode, WITHOUT an extra (-1)^m — the
+    reference's own convention, elementbeam.c:768-775).  Y_l^m =
+    0.5*sqrt((2l+1)/pi*(l-m)!/(l+m)!) * P_l^m(cos th) * e^{i m ph}.
+    theta in [0, pi/2], phi in [0, 2 pi).  Host-side numpy: the basis is
+    built once per run over M cluster centroids."""
+    theta = np.atleast_1d(np.asarray(theta, np.float64))
+    phi = np.atleast_1d(np.asarray(phi, np.float64))
+    Nt = theta.shape[0]
+    ct = np.cos(theta)
+    out = np.empty((Nt, n0 * n0), np.complex128)
+    idx = 0
+    for l in range(n0):
+        pos = {}
+        for m in range(0, l + 1):
+            pre = 0.5 * math.sqrt(
+                (2.0 * l + 1.0) / math.pi
+                * math.factorial(l - m) / math.factorial(l + m)
+            )
+            pos[m] = pre * _assoc_legendre(l, m, ct) * np.exp(1j * m * phi)
+        for mi in range(0, 2 * l + 1):
+            m_true = mi - l
+            out[:, idx] = (np.conj(pos[-m_true]) if m_true < 0
+                           else pos[m_true])
+            idx += 1
+    return out
+
+
+def spatial_basis_modes(ll, mm, n0: int, beta: Optional[float] = None,
+                        basis: str = "shapelet"):
+    """Raw mode matrix (M, G) over cluster centroids, either basis
+    (the master's ``spatialreg_basis`` switch, sagecal_master.cpp:359-367
+    and 380-397):
+      shapelet:  modes at (-l, m) — the diffuse sky shapelet model is in
+        (-l, m), master:360-362 — with auto scale
+        beta = 4*sqrt(l_max^2/M) when ``beta`` is None (master:380);
+      sharmonic: modes at (r, th) = (sqrt(l^2+m^2)*pi/2, atan2(m, l))
+        (master:364-366), no scale parameter.
+    Returns (modes (M, G) complex128, beta_used)."""
+    ll = np.asarray(ll, np.float64)
+    mm = np.asarray(mm, np.float64)
+    if basis == "sharmonic":
+        rr = np.sqrt(ll * ll + mm * mm) * (np.pi / 2.0)
+        tt = np.arctan2(mm, ll)
+        return sharmonic_mode_matrix(rr, tt, n0), 0.0
+    if basis != "shapelet":
+        raise ValueError(f"unknown spatial basis {basis!r}")
     from sagecal_tpu.ops.shapelets import image_mode_matrix
 
-    phi = image_mode_matrix(jnp.asarray(ll), jnp.asarray(mm), beta, n0)  # (M, G)
-    M, G = phi.shape
+    if beta is None or beta <= 0.0:
+        l_max = max(float(np.max(np.abs(ll))), float(np.max(np.abs(mm))),
+                    1e-12)
+        beta = 4.0 * math.sqrt(l_max * l_max / max(len(ll), 1))
+    phi = np.asarray(
+        image_mode_matrix(jnp.asarray(-ll), jnp.asarray(mm), beta, n0),
+        np.complex128,
+    )
+    return phi, float(beta)
+
+
+def basis_blocks(modes) -> jax.Array:
+    """Mode matrix (M, G) -> per-cluster blocks Phi_k = kron(phi_k, I_2):
+    (M, 2G, 2), rows ordered (g, i) (sagecal_master.cpp:408-414)."""
+    modes = jnp.asarray(modes, jnp.complex128)
+    M, G = modes.shape
     eye = jnp.eye(2, dtype=jnp.complex128)
-    Phi = jnp.einsum("mg,ij->mgij", phi.astype(jnp.complex128), eye)
-    return Phi.reshape(M, 2 * G, 2)  # rows ordered (g, i)
+    Phi = jnp.einsum("mg,ij->mgij", modes, eye)
+    return Phi.reshape(M, 2 * G, 2)
+
+
+def build_spatial_basis(ll, mm, n0: int, beta: Optional[float] = None,
+                        basis: str = "shapelet"):
+    """Per-cluster spatial basis blocks Phi: (M, 2G, 2), G = n0*n0,
+    evaluated at the cluster centroids (the master's basis setup,
+    sagecal_master.cpp:293-423).  See :func:`spatial_basis_modes` for
+    the basis/scale conventions."""
+    modes, _ = spatial_basis_modes(ll, mm, n0, beta, basis)
+    return basis_blocks(modes)
 
 
 def phikk_matrix(Phi, lam: float = 1e-6):
@@ -143,3 +239,43 @@ def minimum_description_length(
     aic = np.asarray(aic)
     mdl = np.asarray(mdl)
     return aic, mdl, orders[int(np.argmin(aic))], orders[int(np.argmin(mdl))]
+
+
+def find_initial_spatial(B, modes, N: int) -> jax.Array:
+    """Initial diffuse spatial model Zdiff0: (2*N*Npoly, 2G) such that
+    B_f Zdiff0 Phi_k ~ 1_N kron I_2 for every frequency f and cluster k
+    (``find_initial_spatial``, consensus_poly.c:1113; intent stated at
+    sagecal_master.cpp:658-660).
+
+    Closed form: Zdiff0 rows (p, station i, comp a), Npoly-major in our
+    mesh flattening (mesh._zbar_blocks_of_z);
+    Zdiff0[p*2N + 2i + a, 2g + b] = c_p * delta_ab * s_g with
+      c = pinv(sum_f b_f b_f^T) sum_f b_f          (frequency fit of 1)
+      s = (sum_k phi_k)^H pinv(sum_k phi_k phi_k^H) (spatial fit of 1).
+    NOTE the reference's assembly loop scales by sum_f b_f instead of
+    the pseudo-inverse product its own comment derives
+    (consensus_poly.c:1455 vs master:660); we implement the derivation.
+
+    B: (Nf, Npoly) real; modes: (Meff, G) complex (spatial_basis_modes).
+    """
+    B = np.asarray(B, np.float64)
+    sum_b = B.sum(axis=0)
+    c = np.linalg.pinv(B.T @ B) @ sum_b  # (Npoly,)
+    phi = np.asarray(modes, np.complex128)  # (Meff, G)
+    sum_phi = phi.sum(axis=0)
+    P = phi.T @ np.conj(phi)  # sum_k phi_k phi_k^H
+    s = np.conj(sum_phi) @ np.linalg.pinv(P)  # (G,)
+    Zc = np.tile(np.kron(s[None, :], np.eye(2)), (N, 1))  # (2N, 2G)
+    Z0 = np.concatenate([cp * Zc for cp in c], axis=0)  # (Npoly*2N, 2G)
+    return jnp.asarray(Z0)
+
+
+def bz_spatial(Zs, B_f, N: int) -> jax.Array:
+    """Per-frequency spatial model B_f x Zs: (2N, 2G) from the full
+    Zs (2*N*Npoly, 2G), Npoly-major rows — the slave's reduction of the
+    master-sent spatial model before the diffuse re-predict
+    (sagecal_slave.cpp:670-684)."""
+    Zs = jnp.asarray(Zs)
+    Npoly = B_f.shape[-1]
+    blocks = Zs.reshape(Npoly, 2 * N, Zs.shape[-1])
+    return jnp.einsum("p,pij->ij", jnp.asarray(B_f, jnp.float64), blocks)

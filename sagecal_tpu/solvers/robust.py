@@ -77,7 +77,9 @@ def update_nu_aecm(
     deltanu = (nuhigh - nulow) / Nd
     grid = nulow + deltanu * jnp.arange(Nd)
     score = -digamma(grid * 0.5) + jnp.log(grid * 0.5) + logsumw + dgm + 1.0
-    return grid[jnp.argmin(jnp.abs(score))]
+    # keep the caller's dtype: under x64 the grid is f64 and would
+    # otherwise promote an f32 EM carry (caught by the config-3 AOT test)
+    return grid[jnp.argmin(jnp.abs(score))].astype(jnp.result_type(nu_old))
 
 
 def robust_lm_solve(

@@ -118,14 +118,55 @@ class SageResult(NamedTuple):
 def build_cluster_data(
     data: VisData, clusters: Sequence[SourceBatch], nchunks: Sequence[int],
     fdelta: Optional[float] = None,
+    shapelets=None,
 ) -> ClusterData:
     """Precompute coherencies + chunk maps (host-side, once per tile).
 
     Equivalent of ``precalculate_coherencies`` for all clusters
     (predict.c:503; stored layout ``coh`` Dirac.h / fullbatch_mode.cpp:371).
+
+    ``shapelets``: sky-global :class:`ShapeletTable` (from
+    ``io.skymodel.load_sky``) for clusters containing ST_SHAPELET
+    sources; those clusters take the per-cluster path.
     """
     if fdelta is None:
         fdelta = data.deltaf
+    if shapelets is not None:
+        from sagecal_tpu.ops.rime import ST_SHAPELET as _ST_SH
+
+        shap_flags = [
+            bool(np.any(np.asarray(c.stype) == _ST_SH)) for c in clusters
+        ]
+        if any(shap_flags):
+            # Split: shapelet-containing clusters take the per-cluster
+            # path (they need the mode table); everything else keeps the
+            # batched path — one diffuse cluster must not collapse a
+            # 100-cluster point sky back to 100 separate dispatches.
+            plain_idx = [i for i, f in enumerate(shap_flags) if not f]
+            shap_idx = [i for i, f in enumerate(shap_flags) if f]
+            plain_cd = build_cluster_data(
+                data, [clusters[i] for i in plain_idx],
+                [nchunks[i] for i in plain_idx], fdelta,
+            ) if plain_idx else None
+            coh_parts = {}
+            for i in shap_idx:
+                coh_parts[i] = predict_coherencies(
+                    data.u, data.v, data.w, data.freqs, clusters[i],
+                    fdelta, shapelets=shapelets,
+                )
+            for j, i in enumerate(plain_idx):
+                coh_parts[i] = plain_cd.coh[j]
+            coh = jnp.stack([coh_parts[i] for i in range(len(clusters))])
+            cmaps = []
+            for nch in nchunks:
+                tilechunk = -(-data.tilesz // nch)
+                cmaps.append(jnp.minimum(
+                    data.time_idx // tilechunk, nch - 1).astype(jnp.int32))
+            return ClusterData(
+                coh=coh,
+                chunk_map=jnp.stack(cmaps),
+                nchunk=jnp.asarray(list(nchunks), jnp.int32),
+            )
     sizes = [int(c.ll.shape[0]) for c in clusters]
     smax, total = max(sizes), sum(sizes)
     if smax * len(clusters) <= 4 * total and len(clusters) > 1:
@@ -178,7 +219,7 @@ def build_cluster_data(
     else:
         coh = jnp.stack([
             predict_coherencies(data.u, data.v, data.w, data.freqs, src,
-                                fdelta)
+                                fdelta, shapelets=shapelets)
             for src in clusters
         ])
     cmaps = []
@@ -207,6 +248,7 @@ def build_cluster_data_withbeam(
     dec0: float,
     fdelta: Optional[float] = None,
     wideband: bool = False,
+    shapelets=None,
 ) -> ClusterData:
     """Beam-aware tile precompute: per cluster, evaluate the station beam
     toward each source and fold it into the coherencies
@@ -234,6 +276,7 @@ def build_cluster_data_withbeam(
             predict_coherencies_withbeam(
                 data.u, data.v, data.w, data.freqs, src, B,
                 data.time_idx, data.ant_p, data.ant_q, fdelta,
+                shapelets=shapelets,
             )
         )
         tilechunk = -(-data.tilesz // nch)

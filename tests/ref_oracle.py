@@ -154,6 +154,79 @@ def ref_lbfgs_fit(cost, grad, p0, itmax=100, mem=7):
     return p, rv
 
 
+def ref_bfgsfit(
+    u, v, w, x, nstations, nbase, tilesz, sta1, sta2, coh, m,
+    p0, *, freq0=150e6, fdelta=180e3, uvmin=0.0, nthreads=1,
+    max_lbfgs=20, lbfgs_m=7, solver_mode=2, mean_nu=5.0,
+):
+    """Run the reference ``bfgsfit_visibilities`` (Dirac.h:1683,
+    lmfit.c:1126): the joint LBFGS-only multi-cluster fit — the same
+    work bench.py times per iteration (full-model predict + gradient
+    over all 8*N*M parameters; robust Student's-t cost when
+    solver_mode is one of the R-LBFGS modes).  Shapes as in
+    :func:`ref_sagefit`.  Returns (jones, res_0, res_1, retval)."""
+    lib = load_lib()
+    assert lib is not None
+    rows = nbase * tilesz
+    assert x.shape == (4, rows) and coh.shape == (m, 4, rows)
+
+    uu = np.ascontiguousarray(u, np.float64)
+    vv = np.ascontiguousarray(v, np.float64)
+    ww = np.ascontiguousarray(w, np.float64)
+    xr = np.empty((rows, 8), np.float64)
+    xr[:, 0::2] = x.real.T
+    xr[:, 1::2] = x.imag.T
+    xr = np.ascontiguousarray(xr.reshape(-1))
+
+    barr = (BaselineT * rows)()
+    for i in range(rows):
+        barr[i].sta1 = int(sta1[i])
+        barr[i].sta2 = int(sta2[i])
+        barr[i].flag = 0
+
+    coh_ref = np.ascontiguousarray(
+        np.transpose(coh, (2, 0, 1)), np.complex128
+    )
+
+    n8 = 8 * nstations
+    carr = (ClusSourceT * m)()
+    pidx = (ctypes.c_int * m)()
+    for cm in range(m):
+        pidx[cm] = n8 * cm
+        carr[cm].nchunk = 1
+        carr[cm].p = ctypes.cast(
+            ctypes.byref(pidx, cm * ctypes.sizeof(ctypes.c_int)),
+            ctypes.POINTER(ctypes.c_int),
+        )
+
+    pp = np.empty((m, nstations, 4, 2), np.float64)
+    flat = p0.reshape(m, nstations, 4)
+    pp[..., 0] = flat.real
+    pp[..., 1] = flat.imag
+    pp = np.ascontiguousarray(pp.reshape(-1))
+
+    res_0 = ctypes.c_double(0.0)
+    res_1 = ctypes.c_double(0.0)
+    as_pd = lambda a: a.ctypes.data_as(_PD)
+    lib.bfgsfit_visibilities.restype = ctypes.c_int
+    rv = lib.bfgsfit_visibilities(
+        as_pd(uu), as_pd(vv), as_pd(ww), as_pd(xr),
+        ctypes.c_int(nstations), ctypes.c_int(nbase), ctypes.c_int(tilesz),
+        barr, carr,
+        coh_ref.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(m), ctypes.c_int(m),
+        ctypes.c_double(freq0), ctypes.c_double(fdelta),
+        as_pd(pp), ctypes.c_double(uvmin), ctypes.c_int(nthreads),
+        ctypes.c_int(max_lbfgs), ctypes.c_int(lbfgs_m),
+        ctypes.c_int(128), ctypes.c_int(solver_mode),
+        ctypes.c_double(mean_nu),
+        ctypes.byref(res_0), ctypes.byref(res_1),
+    )
+    sol = pp.reshape(m, nstations, 4, 2)
+    jones = (sol[..., 0] + 1j * sol[..., 1]).reshape(m, nstations, 2, 2)
+    return jones, res_0.value, res_1.value, rv
+
+
 def ref_sagefit(
     u, v, w, x, nstations, nbase, tilesz, sta1, sta2, coh, m,
     p0, *, freq0=150e6, fdelta=180e3, uvmin=0.0, nthreads=2,

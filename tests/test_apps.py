@@ -43,7 +43,7 @@ def _make_dataset(path, nstations=7, ntime=4, nchan=2, jones=None, seed=0,
         skyf = os.path.join(td, "s.txt")
         open(skyf, "w").write(SKY)
         open(skyf + ".cluster", "w").write(CLUSTER)
-        clusters, _ = load_sky(skyf, skyf + ".cluster",
+        clusters, _, _ = load_sky(skyf, skyf + ".cluster",
                                0.0, math.radians(51.0), dtype=np.float64)
     simulate_dataset(
         str(path), nstations=nstations, ntime=ntime, nchan=nchan,
@@ -180,7 +180,7 @@ class TestBeamAndFlags:
         dsp = workdir / "d.h5"
         jones = random_jones(2, 7, seed=3, amp=0.1, dtype=np.complex128)
         _make_dataset(dsp, jones=jones, with_beam=True)
-        clusters, _ = load_sky(
+        clusters, _, _ = load_sky(
             str(workdir / "t.sky.txt"), str(workdir / "t.sky.txt.cluster"),
             0.0, math.radians(51.0), dtype=np.float64,
         )

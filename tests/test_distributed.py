@@ -28,7 +28,7 @@ def _make_bands(tmp_path, Nf=4, nstations=7, ntime=2, seed=5):
     sky = tmp_path / "t.sky.txt"
     sky.write_text(SKY)
     (tmp_path / "t.sky.txt.cluster").write_text(CLUSTER)
-    clusters, _ = load_sky(str(sky), str(sky) + ".cluster",
+    clusters, _, _ = load_sky(str(sky), str(sky) + ".cluster",
                            0.0, math.radians(51.0), dtype=np.float64)
     rng = np.random.default_rng(seed)
     M, N = 2, nstations
@@ -126,7 +126,7 @@ class TestDistributedDriver:
         sky = tmp_path / "t.sky.txt"
         sky.write_text(SKY)
         (tmp_path / "t.sky.txt.cluster").write_text(CLUSTER)
-        clusters, _ = _ls(str(sky), str(sky) + ".cluster",
+        clusters, _, _ = _ls(str(sky), str(sky) + ".cluster",
                           0.0, _math.radians(51.0), dtype=np.float64)
         for i, nt in enumerate((3, 5)):  # unequal ntime
             p = tmp_path / f"band{i}.h5"
@@ -145,3 +145,79 @@ class TestDistributedDriver:
         )
         traces = run_distributed(cfg, log=lambda *a: None)
         assert len(traces) == 2  # ceil(3/2) tiles over the common range
+
+
+SKY3 = SKY + "SDIF 0 1 0.0 50 45 0.0 1.0 0 0 0 0 0 0 0 1 1 0 150e6\n"
+CLUSTER3 = CLUSTER + "3 1 SDIF\n"
+
+
+@pytest.mark.slow
+class TestDriverSpatialExtensions:
+    def test_mdl_diffuse_sharmonic_driver(self, tmp_path, devices8):
+        """Driver run with --mdl, a spherical-harmonic... no: shapelet
+        basis + diffuse-constrained shapelet cluster + MDL logging over
+        two tiles (so the between-tile diffuse refresh branch executes:
+        the second tile's diffuse coherencies come from tile 1's
+        Zspat_diff; master:649-926, slave:670-698, mdl.c)."""
+        Nf = 4
+        paths, sky = _make_bands(tmp_path, Nf=Nf, ntime=4)
+        # calibration sky adds an all-shapelet diffuse cluster (the
+        # simulated data does not contain it; the path under test is
+        # the coherency refresh, not the astrophysics)
+        sky3 = tmp_path / "t3.sky.txt"
+        sky3.write_text(SKY3)
+        (tmp_path / "t3.sky.txt.cluster").write_text(CLUSTER3)
+        n0m, beta = 2, 2e-3
+        rng = np.random.default_rng(11)
+        lines = ["0 0 0 50 45 0", f"{n0m} {beta}"]
+        for k, v in enumerate(rng.standard_normal(n0m * n0m)):
+            lines.append(f"{k} {v}")
+        (tmp_path / "SDIF.fits.modes").write_text("\n".join(lines) + "\n")
+
+        solf = str(tmp_path / "zsol3.txt")
+        cfg = RunConfig(
+            dataset=str(tmp_path / "band*.h5"),
+            sky_model=str(sky3),
+            cluster_file=str(sky3) + ".cluster",
+            out_solutions=solf,
+            tilesz=2, max_emiter=1, max_iter=6, npoly=2,
+            admm_iters=6, admm_rho=10.0, solver_mode=1,
+        )
+        logs = []
+        traces = run_distributed(
+            cfg, log=lambda *a: logs.append(" ".join(str(x) for x in a)),
+            spatial_n0=2, spatial_beta=-1.0, spatial_mu=1e-4,
+            spatial_cadence=2, spatial_basis="shapelet",
+            spatial_diffuse_id=3, spatial_gamma=0.3, spatial_lam=1e-3,
+            mdl=True,
+        )
+        assert len(traces) == 2  # two tiles -> refresh branch ran
+        for dres, pres in traces:
+            assert np.all(np.isfinite(dres)) and np.all(np.isfinite(pres))
+        joined = "\n".join(logs)
+        assert "MDL: best order" in joined
+        assert "spatial basis shapelet" in joined
+
+    def test_sharmonic_basis_driver(self, tmp_path, devices8):
+        """Same driver path with the spherical-harmonic basis."""
+        Nf = 4
+        paths, sky = _make_bands(tmp_path, Nf=Nf, ntime=2)
+        solf = str(tmp_path / "zsol4.txt")
+        cfg = RunConfig(
+            dataset=str(tmp_path / "band*.h5"),
+            sky_model=str(sky),
+            cluster_file=str(sky) + ".cluster",
+            out_solutions=solf,
+            tilesz=2, max_emiter=1, max_iter=6, npoly=2,
+            admm_iters=5, admm_rho=10.0, solver_mode=1,
+        )
+        logs = []
+        traces = run_distributed(
+            cfg, log=lambda *a: logs.append(" ".join(str(x) for x in a)),
+            spatial_n0=2, spatial_mu=1e-4, spatial_cadence=2,
+            spatial_basis="sharmonic",
+        )
+        assert len(traces) == 1
+        dres, pres = traces[0]
+        assert np.all(np.isfinite(dres)) and pres[-1] < 0.25
+        assert "spatial basis sharmonic" in "\n".join(logs)

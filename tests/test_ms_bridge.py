@@ -1,0 +1,172 @@
+"""MS bridge marshalling test against a FAKE casacore (monkeypatched
+``casacore.tables``): ms_to_h5 -> h5_to_ms round trip so the column
+mapping, (time, baseline) lexsort ordering, flag collapse, and
+autocorrelation handling (src/MS/data.cpp analog) execute in CI even
+though this image has no real casacore."""
+
+import sys
+import types
+
+import h5py
+import numpy as np
+import pytest
+
+NSTA, NTIME, NCHAN = 4, 3, 2
+NBASE = NSTA * (NSTA - 1) // 2
+
+
+class FakeTable:
+    """Minimal casacore.tables.table over an in-memory column dict."""
+
+    store: dict = {}
+
+    def __init__(self, path, readonly=True):
+        self.path = path
+        self.cols = self.store[path]
+
+    def nrows(self):
+        return len(next(iter(self.cols.values())))
+
+    def getcol(self, name):
+        return np.asarray(self.cols[name])
+
+    def putcol(self, name, vals):
+        self.cols[name] = np.asarray(vals)
+
+    def colnames(self):
+        return list(self.cols.keys())
+
+    def getcoldesc(self, name):
+        return {"like": name}
+
+    def addcols(self, desc):
+        # makecoldesc returns (name, desc); create zero-filled like DATA
+        name, _ = desc
+        self.cols[name] = np.zeros_like(np.asarray(self.cols["DATA"]))
+
+    def close(self):
+        pass
+
+
+def _fake_casacore(monkeypatch, store):
+    FakeTable.store = store
+    mod = types.ModuleType("casacore.tables")
+    mod.table = FakeTable
+    mod.makecoldesc = lambda name, desc: (name, desc)
+    pkg = types.ModuleType("casacore")
+    pkg.tables = mod
+    monkeypatch.setitem(sys.modules, "casacore", pkg)
+    monkeypatch.setitem(sys.modules, "casacore.tables", mod)
+
+
+def _fake_ms(rng):
+    """An MS-shaped column store: cross + autocorrelation rows, shuffled
+    so the bridge's lexsort must do real work."""
+    rows = []
+    for ti in range(NTIME):
+        t = 5e9 + 10.0 * ti
+        for a in range(NSTA):
+            rows.append((t, a, a))  # autocorrelation
+        for a in range(NSTA):
+            for b in range(a + 1, NSTA):
+                rows.append((t, a, b))
+    rows = np.asarray(rows)
+    perm = rng.permutation(len(rows))
+    rows = rows[perm]
+    nr = len(rows)
+    data = (rng.standard_normal((nr, NCHAN, 4))
+            + 1j * rng.standard_normal((nr, NCHAN, 4)))
+    flag = rng.random((nr, NCHAN, 4)) < 0.1
+    uvw = rng.standard_normal((nr, 3)) * 100.0
+    ms = {
+        "TIME": rows[:, 0],
+        "ANTENNA1": rows[:, 1].astype(np.int32),
+        "ANTENNA2": rows[:, 2].astype(np.int32),
+        "DATA": data,
+        "FLAG": flag,
+        "UVW": uvw,
+    }
+    store = {
+        "fake.ms": ms,
+        "fake.ms/ANTENNA": {"NAME": np.asarray([f"ST{i}" for i in range(NSTA)])},
+        "fake.ms/SPECTRAL_WINDOW": {
+            "CHAN_FREQ": np.asarray([[140e6, 150e6]])
+        },
+        "fake.ms/FIELD": {
+            "PHASE_DIR": np.asarray([[[0.3, 0.9]]])
+        },
+    }
+    return store
+
+
+def test_ms_to_h5_roundtrip(tmp_path, monkeypatch):
+    from sagecal_tpu.io import dataset as dsm
+
+    rng = np.random.default_rng(7)
+    store = _fake_ms(rng)
+    _fake_casacore(monkeypatch, store)
+    assert dsm.have_casacore()
+
+    h5 = str(tmp_path / "bridge.h5")
+    dsm.ms_to_h5("fake.ms", h5)
+
+    ms = store["fake.ms"]
+    cross = ms["ANTENNA1"] != ms["ANTENNA2"]
+    order = np.lexsort((ms["ANTENNA2"][cross], ms["ANTENNA1"][cross],
+                        ms["TIME"][cross]))
+    want_vis = ms["DATA"][cross][order].reshape(NTIME, NBASE, NCHAN, 2, 2)
+    want_flag = ms["FLAG"][cross][order].reshape(
+        NTIME, NBASE, NCHAN, 4).any(-1)
+
+    with h5py.File(h5, "r") as f:
+        np.testing.assert_allclose(np.asarray(f["vis"]), want_vis)
+        np.testing.assert_array_equal(np.asarray(f["flag"]), want_flag)
+        assert f.attrs["nstations"] == NSTA
+        np.testing.assert_allclose(f.attrs["ra0"], 0.3)
+        np.testing.assert_allclose(f.attrs["dec0"], 0.9)
+        np.testing.assert_allclose(np.asarray(f["freqs"]),
+                                   [140e6, 150e6])
+        # integration time from median TIME diff
+        np.testing.assert_allclose(f.attrs["deltat"], 10.0)
+
+    # the container is loadable through the normal solver-facing path
+    ds = dsm.VisDataset(h5, "r")
+    tile = ds.load_tile(0, 2, average_channels=False, dtype=np.float64)
+    assert tile.nstations == NSTA and tile.tilesz == 2
+    ds.close()
+
+    # ---- write-back direction: h5 'corrected' -> new MS column -------
+    corrected = (rng.standard_normal((NTIME, NBASE, NCHAN, 2, 2))
+                 + 1j * rng.standard_normal((NTIME, NBASE, NCHAN, 2, 2)))
+    with h5py.File(h5, "r+") as f:
+        f.create_dataset("corrected", data=corrected)
+    dsm.h5_to_ms(h5, "fake.ms", column="corrected",
+                 ms_column="CORRECTED_DATA")
+
+    out = store["fake.ms"]["CORRECTED_DATA"]
+    cross_idx = np.flatnonzero(cross)
+    got = out[cross_idx[order]].reshape(NTIME, NBASE, NCHAN, 4)
+    np.testing.assert_allclose(got, corrected.reshape(
+        NTIME, NBASE, NCHAN, 4))
+    # autocorrelation rows untouched: a freshly-created CORRECTED_DATA
+    # seeds from DATA (CASA convention), so they keep the DATA values
+    auto_idx = np.flatnonzero(~cross)
+    np.testing.assert_allclose(out[auto_idx], ms["DATA"][auto_idx])
+
+
+def test_h5_to_ms_row_mismatch_raises(tmp_path, monkeypatch):
+    from sagecal_tpu.io import dataset as dsm
+
+    rng = np.random.default_rng(8)
+    store = _fake_ms(rng)
+    _fake_casacore(monkeypatch, store)
+    h5 = str(tmp_path / "b2.h5")
+    dsm.ms_to_h5("fake.ms", h5)
+    with h5py.File(h5, "r+") as f:
+        # one timeslot short -> row count mismatch must be detected
+        f.create_dataset(
+            "corrected",
+            data=np.zeros((NTIME - 1, NBASE, NCHAN, 2, 2), complex),
+        )
+    with pytest.raises(ValueError, match="cross rows"):
+        dsm.h5_to_ms(h5, "fake.ms", column="corrected")

@@ -266,3 +266,87 @@ def test_anchor_two_cluster_deep():
         f"gauge RMS vs reference {rms:.3e} "
         f"(ref-vs-truth {rms_rt:.3e}, ours-vs-truth {rms_ot:.3e})"
     )
+
+
+@pytest.mark.slow
+def test_anchor_two_cluster_ladder_crossing():
+    """Drive the overlapping-cluster EM ladder DEEP (the VERDICT's
+    1e-5 crossing demand): the ref-vs-ours gauge RMS must decrease
+    monotonically with budget and cross below 1e-5 at the deepest rung
+    — demonstrating the 2e-4 of the fast anchor is EM depth, not a
+    disagreement floor."""
+    data, cdata, jones_true = _scene(m=2)
+    p0 = _identity_p0(2, data.nstations)
+    sta1 = np.asarray(data.ant_p[: data.nbase])
+    sta2 = np.asarray(data.ant_q[: data.nbase])
+    rungs = [
+        dict(max_emiter=8, max_iter=40, max_lbfgs=60),
+        dict(max_emiter=16, max_iter=80, max_lbfgs=160),
+        dict(max_emiter=24, max_iter=120, max_lbfgs=300),
+    ]
+    rms_curve = []
+    truth_curve = []
+    for kw in rungs:
+        j_ref, _, _, r1, _ = _ref_solve(data, cdata, p0, solver_mode=1,
+                                        **kw)
+        j_our, _, o1 = _our_solve(data, cdata, p0,
+                                  solver_mode=SM_LM_LBFGS, **kw)
+        rms_curve.append(_gauge_free_rms(j_ref, j_our, sta1, sta2))
+        truth_curve.append((
+            _gauge_free_rms(j_ref, np.asarray(jones_true), sta1, sta2),
+            _gauge_free_rms(j_our, np.asarray(jones_true), sta1, sta2),
+        ))
+    msg = (f"ladder ref-vs-ours {rms_curve}, "
+           f"(ref,ours)-vs-truth {truth_curve}")
+    assert rms_curve[1] < rms_curve[0] and rms_curve[2] < rms_curve[1], msg
+    assert rms_curve[-1] < 1e-5, msg
+
+
+@pytest.mark.slow
+def test_anchor_bfgsfit_joint_lbfgs():
+    """``bfgsfit_visibilities`` anchor (lmfit.c:1126): the reference's
+    joint LBFGS-only multi-cluster fit vs our joint LBFGS on the same
+    noiseless two-cluster scene — the per-iteration work bench.py
+    times.  Both run Gaussian cost (solver_mode 1 -> lbfgs_fit_wrapper)
+    from identity to deep convergence."""
+    import jax
+
+    from sagecal_tpu.solvers.lbfgs import lbfgs_fit
+    from sagecal_tpu.solvers.sage import predict_full_model
+
+    data, cdata, jones_true = _scene(m=2)
+    p0 = _identity_p0(2, data.nstations)
+    j_ref, r0, r1, rv = ref_oracle.ref_bfgsfit(
+        np.asarray(data.u), np.asarray(data.v), np.asarray(data.w),
+        np.asarray(data.vis[0], np.complex128),
+        data.nstations, data.nbase, data.tilesz,
+        np.asarray(data.ant_p), np.asarray(data.ant_q),
+        np.asarray(cdata.coh[:, 0], np.complex128), 2, p0,
+        freq0=data.freq0, fdelta=0.0, max_lbfgs=500, lbfgs_m=7,
+        solver_mode=1, mean_nu=2.0,
+    )
+    assert r1 < 1e-5 * max(r0, 1e-30), (r0, r1, rv)
+
+    shape = (2, 1, 8 * data.nstations)
+
+    def cost_fn(pflat):
+        model = predict_full_model(pflat.reshape(shape), cdata, data)
+        diff = (data.vis - model) * data.mask[..., None, :]
+        return jnp.sum(jnp.real(diff) ** 2 + jnp.imag(diff) ** 2)
+
+    pj0 = jones_to_params(jnp.asarray(p0))[:, None, :]
+    fit = jax.jit(
+        lambda p: lbfgs_fit(cost_fn, None, p.reshape(-1), itmax=500, M=7)
+    )(pj0)
+    j_our = np.asarray(
+        params_to_jones(fit.p.reshape(shape)[:, 0, :]), np.complex128
+    )
+    sta1 = np.asarray(data.ant_p[: data.nbase])
+    sta2 = np.asarray(data.ant_q[: data.nbase])
+    rms = _gauge_free_rms(j_ref, j_our, sta1, sta2)
+    rms_rt = _gauge_free_rms(j_ref, np.asarray(jones_true), sta1, sta2)
+    rms_ot = _gauge_free_rms(j_our, np.asarray(jones_true), sta1, sta2)
+    assert rms < 1e-5, (
+        f"bfgsfit anchor gauge RMS {rms:.3e} "
+        f"(ref-vs-truth {rms_rt:.3e}, ours-vs-truth {rms_ot:.3e})"
+    )

@@ -76,7 +76,7 @@ def test_full_pipeline_on_reference_sky():
     # phase center at 3C196 (dosage.sh observation)
     ra0 = (8 + 13 / 60 + 36.0 / 3600) * (2 * math.pi / 24)
     dec0 = (48 + 13 / 60) * (math.pi / 180)
-    batches, cdefs = load_sky(
+    batches, cdefs, _ = load_sky(
         os.path.join(FIX, "3c196.sky.txt"),
         os.path.join(FIX, "3c196.sky.txt.cluster"),
         ra0, dec0, dtype=np.float64,

@@ -121,7 +121,7 @@ class TestRestoreBuildskyRoundtrip:
         # output files parse with the standard loaders
         from sagecal_tpu.io.skymodel import load_sky
 
-        clusters, cdefs = load_sky(skyout, skyout + ".cluster",
+        clusters, cdefs, _ = load_sky(skyout, skyout + ".cluster",
                                    ra0, dec0, dtype=np.float64)
         assert len(clusters) == len(srcs)
 
@@ -193,3 +193,90 @@ class TestUvwriter:
             after = np.asarray(f["u"])
         assert after.shape == before.shape
         assert np.abs(after - before).max() > 0
+
+
+class TestBuildMultiSky:
+    """Multi-frequency extraction + spectral-index fitting
+    (buildmultisky.c / fitmultipixels.c role) and DS9 regions
+    (hull.c role)."""
+
+    def _cube(self, tmp_path, freqs, I0, si1, si2, n=96):
+        """Per-channel FITS images of two Gaussian sources whose fluxes
+        follow exp(ln I0 + si1 r + si2 r^2), r = ln(f/fmean)."""
+        from sagecal_tpu.tools.buildsky import _gauss_model
+
+        wcs = FitsWCS(crval1=15.0, crval2=45.0, crpix1=n / 2, crpix2=n / 2,
+                      cdelt1=-3e-3, cdelt2=3e-3)
+        ref = float(np.mean(freqs))
+        yy, xx = np.mgrid[0:n, 0:n].astype(float)
+        pos = [(n / 2, n / 2), (n / 2 + 18, n / 2 - 12)]
+        rng = np.random.default_rng(4)
+        paths = []
+        for ci, f in enumerate(freqs):
+            r = math.log(f / ref)
+            img = 1e-4 * rng.standard_normal((n, n))
+            for k in range(2):
+                amp = math.exp(math.log(I0[k]) + si1[k] * r + si2[k] * r * r)
+                img += _gauss_model(
+                    np.asarray([amp, pos[k][0], pos[k][1], 2.0, 2.0, 0.0]),
+                    xx.ravel(), yy.ravel(), 1).reshape(n, n)
+            p = str(tmp_path / f"chan{ci}.fits")
+            write_fits_image(p, img.astype(np.float32), wcs,
+                             extra={"CRVAL3": float(f)})
+            paths.append(p)
+        return paths, ref
+
+    def test_recovers_spectral_indices(self, tmp_path):
+        from sagecal_tpu.io.skymodel import parse_skymodel
+        from sagecal_tpu.tools.buildsky import buildmultisky
+
+        freqs = [120e6, 150e6, 180e6]
+        I0 = [3.0, 1.5]
+        si1 = [-0.8, 0.6]
+        si2 = [0.2, -0.1]
+        paths, ref = self._cube(tmp_path, freqs, I0, si1, si2)
+        out = str(tmp_path / "multi.sky.txt")
+        reg = str(tmp_path / "multi.reg")
+        srcs = buildmultisky(paths, out, out_regions=reg,
+                             threshold_sigma=6.0, maxP=1,
+                             log=lambda *a: None)
+        assert len(srcs) == 2
+        srcs = sorted(srcs, key=lambda s: -s["flux"])
+        for k in range(2):
+            assert srcs[k]["flux"] == pytest.approx(I0[k], rel=0.1)
+            assert srcs[k]["si"][0] == pytest.approx(si1[k], abs=0.1)
+            assert srcs[k]["si"][1] == pytest.approx(si2[k], abs=0.3)
+        # the emitted 19-token file parses as three-term spectra and the
+        # si columns round-trip through the standard parser
+        sky = parse_skymodel(out)
+        assert len(sky) == 2
+        best = max(sky.values(), key=lambda s: s.sI)
+        assert best.spec_idx == pytest.approx(si1[0], abs=0.1)
+        assert best.f0 == pytest.approx(ref, rel=1e-6)
+        # DS9 regions: one entry per source + island polygons
+        txt = open(reg).read()
+        assert txt.count("text={G") + txt.count("text={P") == 2
+        assert "polygon(" in txt and "fk5" in txt
+
+    def test_convex_hull(self):
+        from sagecal_tpu.tools.buildsky import convex_hull
+
+        pts = np.asarray([[0, 0], [2, 0], [2, 2], [0, 2],
+                          [1, 1], [0.5, 0.7]])
+        hull = convex_hull(pts)
+        assert len(hull) == 4
+        assert {tuple(h) for h in hull} == {(0, 0), (2, 0), (2, 2), (0, 2)}
+
+    def test_single_image_regions_have_hulls(self, tmp_path):
+        """buildsky --regions must include island hull polygons too
+        (hull.c role), not just source markers."""
+        from sagecal_tpu.tools.buildsky import buildsky as _bs
+
+        freqs = [150e6]
+        paths, _ = self._cube(tmp_path, [120e6, 150e6, 180e6],
+                              [3.0, 1.5], [0.0, 0.0], [0.0, 0.0])
+        reg = str(tmp_path / "single.reg")
+        _bs(paths[1], str(tmp_path / "s.sky.txt"), threshold_sigma=6.0,
+            maxP=1, out_regions=reg, log=lambda *a: None)
+        txt = open(reg).read()
+        assert "polygon(" in txt
