@@ -46,6 +46,18 @@ import numpy as np
 #        (re-measured same code: 0.663; round-1 code measured 0.407)
 _CPU_BASELINE_PINNED = {60: 0.0555, 5: 0.663}
 
+# The ACTUAL reference C solver timed at the north-star shape:
+# bfgsfit_visibilities (lmfit.c:1126, robust R-LBFGS mode 2) on the
+# channel-averaged tile, compiled from the mounted reference sources and
+# measured SOLO on this host by `python ref_bench.py` 2026-07-30:
+# 20 iterations in 1535 s = 0.013 it/s (overhead-subtracted; res
+# 7.2e-3 -> 3.9e-4, rc=0).  Semantics caveats in ref_bench.py's
+# docstring — chiefly that the reference evaluates ONE channel-averaged
+# model per iteration vs our TWO channels, i.e. about half the
+# model-evaluation work, and each code runs its own line search.
+_REF_CPU_PINNED = {60: 0.013, 5: None}
+_REF_CPU_THREADS = 1  # this container exposes a single core
+
 NSTATIONS = 62
 NCLUSTERS = 100
 TILESZ = 60
@@ -335,6 +347,8 @@ def main():
         cpu_measured = _measure_cpu_subprocess(tilesz)
     base = cpu_measured or _CPU_BASELINE_PINNED[tilesz]
     vs = value / base if base else None
+    ref_c = _REF_CPU_PINNED.get(tilesz)
+    vs_ref = value / ref_c if ref_c else None
 
     # throughput roofline from ANALYTIC counts (see
     # analytic_flops_per_cost_eval).  Cost-equivalents per LBFGS
@@ -357,6 +371,9 @@ def main():
         "fused_kernel": FUSED,
         "cpu_baseline_iters_per_sec": base,
         "cpu_baseline_source": "measured-live" if cpu_measured else "pinned",
+        "vs_reference_cpu": round(vs_ref, 3) if vs_ref else None,
+        "ref_cpu_iters_per_sec": ref_c,
+        "ref_cpu_threads": _REF_CPU_THREADS if ref_c else None,
         "north_star_shape": tilesz == TILESZ,
         "analytic_tflops_per_sec": round(flops_per_sec / 1e12, 4),
         "analytic_hbm_gb_per_sec": round(gbytes_per_sec, 1),

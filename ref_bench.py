@@ -72,7 +72,7 @@ def main():
     j0 = np.asarray(params_to_jones(p0[:, 0]))     # (M, N, 2, 2)
 
     nthreads = os.cpu_count() or 1
-    iters = bench.LBFGS_ITERS
+    iters = int(os.environ.get("REF_BENCH_ITERS", bench.LBFGS_ITERS))
 
     def run(max_lbfgs):
         t0 = time.perf_counter()

@@ -116,19 +116,46 @@ def _name_sources(sources: List[dict]) -> None:
         s["name"] = f"{'P' if s['point'] else 'G'}{s['island']}C{i}"
 
 
+def hierarchical_cluster(l, m, ncut: int) -> np.ndarray:
+    """Agglomerative centroid-linkage clustering of (l, m) positions,
+    cut at ``ncut`` clusters — the reference's negative ``-k`` path
+    (``hierarchical_clustering``, scluster.c:709-740: ``treecluster``
+    with Euclidean metric + pairwise centroid linkage, then
+    ``cuttree``).  scipy's linkage/fcluster replaces the embedded C
+    Clustering Library.  Returns 0-based int assignments."""
+    from scipy.cluster.hierarchy import fcluster, linkage
+
+    pts = np.stack([np.asarray(l, float), np.asarray(m, float)], axis=1)
+    n = len(pts)
+    ncut = max(1, min(ncut, n))
+    if n == 1:
+        return np.zeros(1, np.int64)
+    Z = linkage(pts, method="centroid", metric="euclidean")
+    return np.asarray(fcluster(Z, t=ncut, criterion="maxclust")) - 1
+
+
 def _write_cluster_file(sources: List[dict], out_cluster: str,
                         nclusters: int) -> None:
-    """Cluster file: k-means into ``nclusters`` groups, or one cluster
-    per source (scluster.c -Q role)."""
+    """Cluster file: ``nclusters`` > 0 -> weighted k-means;
+    < 0 -> hierarchical centroid-linkage cut at ``|nclusters|``
+    (the reference's -k sign convention, buildsky main.c:43);
+    0 -> one cluster per source."""
+    assign = None
+    if nclusters < 0 and len(sources) > 1:
+        assign = hierarchical_cluster(
+            [s["l"] for s in sources], [s["m"] for s in sources],
+            min(-nclusters, len(sources)),
+        )
+    elif nclusters and len(sources) > 1:
+        assign, _ = kmeans_weighted(
+            [s["l"] for s in sources], [s["m"] for s in sources],
+            [abs(s["flux"]) for s in sources],
+            min(nclusters, len(sources)),
+        )
     with open(out_cluster, "w") as fh:
         fh.write("# cluster_id hybrid source_names...\n")
-        if nclusters and len(sources) > 1:
-            assign, _ = kmeans_weighted(
-                [s["l"] for s in sources], [s["m"] for s in sources],
-                [abs(s["flux"]) for s in sources],
-                min(nclusters, len(sources)),
-            )
-            for cid in range(int(assign.max()) + 1 if len(assign) else 0):
+        if assign is not None and len(assign):
+            for cid in range(int(assign.max()) + 1):
                 names = [s["name"] for s, a in zip(sources, assign)
                          if a == cid]
                 if names:
@@ -154,8 +181,10 @@ def buildsky(
     """Extract sources; write the LSM sky + cluster files.
 
     ``nclusters``: 0 = one cluster per source (the reference's
-    create_clusters default), else weighted k-means into that many
-    clusters (scluster.c -Q role).  Returns the source dicts.
+    create_clusters default), > 0 = weighted k-means into that many
+    clusters, < 0 = hierarchical centroid-linkage cut at ``|nclusters|``
+    (the reference's -k sign convention, scluster.c / main.c:43).
+    Returns the source dicts.
     """
     img, wcs, hdr = read_fits_image(fits_path)
     if freq0 is None:
@@ -429,7 +458,9 @@ def main(argv=None):
                     choices=("aic", "bic", "mdl"),
                     help="model-order criterion (ref -a)")
     ap.add_argument("-Q", "--nclusters", type=int, default=0,
-                    help="k-means cluster count (0 = one per source)")
+                    help="cluster count: >0 weighted k-means, <0 "
+                    "hierarchical centroid-linkage cut at |Q| (ref -k "
+                    "sign convention), 0 = one per source")
     ap.add_argument("--multi", nargs="+", default=None, metavar="FITS",
                     help="additional per-frequency FITS images: fit "
                     "spectral indices across all of them "

@@ -280,3 +280,33 @@ class TestBuildMultiSky:
             maxP=1, out_regions=reg, log=lambda *a: None)
         txt = open(reg).read()
         assert "polygon(" in txt
+
+
+class TestHierarchicalClustering:
+    def test_centroid_linkage_separates_groups(self):
+        from sagecal_tpu.tools.buildsky import hierarchical_cluster
+
+        rng = np.random.default_rng(2)
+        l = np.concatenate([rng.normal(0, 0.01, 8),
+                            rng.normal(0.1, 0.01, 8),
+                            rng.normal(-0.1, 0.01, 8)])
+        m = np.concatenate([rng.normal(0, 0.01, 8),
+                            rng.normal(0.1, 0.01, 8),
+                            rng.normal(0.1, 0.01, 8)])
+        assign = hierarchical_cluster(l, m, 3)
+        assert len(set(assign)) == 3
+        for g in range(3):
+            grp = assign[8 * g:8 * (g + 1)]
+            assert len(set(grp.tolist())) == 1, assign
+
+    def test_negative_nclusters_writes_hierarchical_file(self, tmp_path):
+        from sagecal_tpu.tools.buildsky import _write_cluster_file
+
+        srcs = [dict(name=f"P{i}", l=0.1 * (i // 3), m=0.0, flux=1.0)
+                for i in range(9)]
+        out = str(tmp_path / "h.cluster")
+        _write_cluster_file(srcs, out, -3)
+        lines = [ln for ln in open(out) if not ln.startswith("#")]
+        assert len(lines) == 3
+        names = sorted(n for ln in lines for n in ln.split()[2:])
+        assert names == sorted(s["name"] for s in srcs)
