@@ -39,12 +39,14 @@ import numpy as np
 #   python -c "import bench; print(bench._measure_cpu_subprocess(60))"
 # pinned per workload shape (tilesz -> iters/sec, f64 CPU):
 #   60 = the north-star shape (BASELINE.md graded config 1, -t 60);
-#        re-measured with the round-3 two-stage factored predict:
-#        0.0555 it/s (history: round-2 layout 0.0142, rows-minor layout
-#        0.0212 — every TPU-first restructuring also sped up the CPU)
+#        re-measured SOLO with the round-4 value_and_grad LBFGS
+#        restructure AND the coh-dtype fix keeping f64 genuinely f64:
+#        0.0633 it/s (history: round-2 layout 0.0142, rows-minor
+#        0.0212, round-3 factored predict 0.0555 — every TPU-first
+#        restructuring also sped up the CPU)
 #    5 = the small shape used when falling back to the CPU platform
-#        (re-measured same code: 0.663; round-1 code measured 0.407)
-_CPU_BASELINE_PINNED = {60: 0.0555, 5: 0.663}
+#        (re-measured same code: 0.888; round-3 0.663, round-1 0.407)
+_CPU_BASELINE_PINNED = {60: 0.0633, 5: 0.888}
 
 # The ACTUAL reference C solver timed at the north-star shape:
 # bfgsfit_visibilities (lmfit.c:1126, robust R-LBFGS mode 2) on the
@@ -70,6 +72,14 @@ V5E_BF16_PEAK_FLOPS = 197e12  # TPU v5e per-chip peak (bf16)
 # Cost path selector, read ONCE so run() and the JSON record can't
 # diverge: 1 = fused Pallas RIME kernel, 0 = XLA predict path.
 FUSED = bool(int(os.environ.get("SAGECAL_BENCH_FUSED", "0")))
+
+# Store the (static) coherency stack as bfloat16, upcast to f32 inside
+# the jitted cost: halves the dominant HBM stream of the bandwidth-
+# bound evaluation.  Gains/visibilities/accumulation stay f32.
+# Accuracy note: bf16 has ~3 significant digits — fine for the bench's
+# throughput claim and for early EM iterations, NOT for the final
+# 1e-6-bar solve; production keeps f32 coherencies by default.
+COH_BF16 = bool(int(os.environ.get("SAGECAL_BENCH_COH_BF16", "0")))
 
 
 from sagecal_tpu.utils.platform import (  # noqa: E402
@@ -129,7 +139,10 @@ def make_step(data, cdata, nu=5.0):
     @jax.jit
     def step(vis_ri, mask, coh_ri, p0):
         vis = jax.lax.complex(vis_ri[:, :4, :], vis_ri[:, 4:, :])
-        coh = jax.lax.complex(coh_ri[:, :, :4, :], coh_ri[:, :, 4:, :])
+        # upcast to the RUN dtype (bf16 -> f32 under COH_BF16; keeps
+        # the f64 CPU-baseline path genuinely f64)
+        coh_f = coh_ri.astype(vis_ri.dtype)
+        coh = jax.lax.complex(coh_f[:, :, :4, :], coh_f[:, :, 4:, :])
         d = data.replace(vis=vis, mask=mask)
         c = cdata._replace(coh=coh)
 
@@ -215,13 +228,15 @@ def analytic_flops_per_cost_eval(tilesz=TILESZ):
     return model + coefs + residual
 
 
-def hbm_bytes_per_cost_eval(tilesz=TILESZ, bytes_per_cplx=8):
+def hbm_bytes_per_cost_eval(tilesz=TILESZ, coh_bytes_per_cplx=8,
+                            vis_bytes_per_cplx=8):
     """Minimum HBM traffic of one cost evaluation: the coherency stack
     read once + visibilities/mask — the workload is bandwidth-bound
-    (elementwise VPU math; 2x2 RIME products never reach the MXU)."""
+    (elementwise VPU math; 2x2 RIME products never reach the MXU).
+    Separate coh/vis byte widths: COH_BF16 halves only the stack."""
     rows = NSTATIONS * (NSTATIONS - 1) // 2 * tilesz
-    coh = NCLUSTERS * NCHAN * 4 * rows * bytes_per_cplx
-    vis = NCHAN * 4 * rows * bytes_per_cplx + NCHAN * rows * 4
+    coh = NCLUSTERS * NCHAN * 4 * rows * coh_bytes_per_cplx
+    vis = NCHAN * 4 * rows * vis_bytes_per_cplx + NCHAN * rows * 4
     return coh + vis
 
 
@@ -248,6 +263,10 @@ def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ):
     # through the axon tunnel vs 74 ms for the whole predict once the
     # arrays are device-resident.  device_put once, time steady state.
     dev = jax.devices()[0]
+    if COH_BF16 and not FUSED:
+        import ml_dtypes
+
+        coh_ri = coh_ri.astype(ml_dtypes.bfloat16)
     args = tuple(jax.device_put(a, dev) for a in (vis_ri, mask, coh_ri, p0_h))
     # NOTE: block_until_ready is a NO-OP on axon; the transfers are
     # actually drained by the untimed warm-up call + host read below,
@@ -294,7 +313,8 @@ def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ):
 
 def _measure_cpu_subprocess(tilesz=TILESZ, timeout=1800.0):
     """Re-measure the CPU f64 baseline in a fresh process (optional)."""
-    env = {k: v for k, v in os.environ.items() if k != "SAGECAL_BENCH_FUSED"}
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("SAGECAL_BENCH_FUSED", "SAGECAL_BENCH_COH_BF16")}
     code = (
         "import jax, numpy as np; jax.config.update('jax_platforms','cpu');"
         "jax.config.update('jax_enable_x64', True);"
@@ -352,13 +372,17 @@ def main():
 
     # throughput roofline from ANALYTIC counts (see
     # analytic_flops_per_cost_eval).  Cost-equivalents per LBFGS
-    # iteration: Armijo evaluates the cost at x and at the first trial
-    # point (2x), the gradient is one reverse-mode pass (~2x a cost
-    # eval); +3 per fit for the initial gradient and final cost.  Lower
-    # bound: extra line-search halvings are not counted.
-    cost_evals = 4 * iters + 3
+    # iteration after the fused value_and_grad restructure (the loop
+    # carries f, Armijo reuses it): first trial point (1x) + one
+    # value_and_grad pass (~2x a cost eval) = 3x; +2 per fit for the
+    # initial value_and_grad (the final cost is carried, not
+    # re-evaluated).  Lower bound: extra line-search halvings are not
+    # counted.
+    cost_evals = 3 * iters + 2
     fl_eval = analytic_flops_per_cost_eval(tilesz)
-    by_eval = hbm_bytes_per_cost_eval(tilesz)
+    by_eval = hbm_bytes_per_cost_eval(
+        tilesz, coh_bytes_per_cplx=4 if COH_BF16 and not FUSED else 8
+    )
     flops_per_sec = cost_evals * fl_eval / dt
     gbytes_per_sec = cost_evals * by_eval / dt / 1e9
 
@@ -369,6 +393,7 @@ def main():
         "vs_baseline": round(vs, 3) if vs else None,
         "platform": platform,
         "fused_kernel": FUSED,
+        "coh_bf16": COH_BF16 and not FUSED,
         "cpu_baseline_iters_per_sec": base,
         "cpu_baseline_source": "measured-live" if cpu_measured else "pinned",
         "vs_reference_cpu": round(vs_ref, 3) if vs_ref else None,

@@ -457,6 +457,20 @@ def _run_distributed_inner(
             f"[{timer.tile_summary()}]"
         )
       log(f"phases: {timer.run_summary()}")
+      # end-of-run spatial-model amplitude plot (the master's PPM
+      # output, sagecal_master.cpp:1198 / pngoutput.c) from the final
+      # tile's Zspat — shapelet basis only (the plot evaluates the
+      # image-plane shapelet series)
+      if (spatial_n0 > 0 and spatial_basis == "shapelet" and pairs
+              and out.Zspat is not None):
+          from sagecal_tpu.utils.ppm import plot_spatial_model
+
+          ppm_path = f"{cfg.out_solutions}.spatial.ppm"
+          plot_spatial_model(
+              np.asarray(out.Zspat), cfg.npoly, N, spatial_n0,
+              beta=diffuse_beta or spatial_beta, path=ppm_path,
+          )
+          log(f"spatial model plot -> {ppm_path}")
     finally:
         # reap every band's prefetch thread even on a mid-loop failure
         for pf in prefetchers:

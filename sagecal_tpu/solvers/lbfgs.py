@@ -107,11 +107,16 @@ def _two_loop_direction(g: jax.Array, mem: LBFGSMemory) -> jax.Array:
 
 
 def armijo_backtrack(
-    cost_fn: Callable, x: jax.Array, p: jax.Array, g: jax.Array, alpha0
+    cost_fn: Callable, x: jax.Array, p: jax.Array, g: jax.Array, alpha0,
+    fold=None,
 ) -> jax.Array:
-    """Armijo halving search (lbfgs.c:444-475): c=1e-4, at most 15 halvings."""
+    """Armijo halving search (lbfgs.c:444-475): c=1e-4, at most 15
+    halvings.  Pass ``fold`` = cost(x) when the caller already has it —
+    on the bandwidth-bound calibration cost every avoided evaluation is
+    a full pass over the coherency stack."""
     c = 1e-4
-    fold = cost_fn(x)
+    if fold is None:
+        fold = cost_fn(x)
     product = c * jnp.dot(p, g)
 
     def cond(st):
@@ -155,13 +160,21 @@ def lbfgs_fit(
     memory, alphabar=1).
     """
     n = p0.shape[0]
+    # fused value+gradient: the reverse pass shares its forward with the
+    # cost, so (f, g) together cost ~one gradient — carrying f through
+    # the loop then saves the cost_fn(x) re-evaluation Armijo would
+    # otherwise make every iteration (one full pass over the coherency
+    # stack on the calibration cost)
     if grad_fn is None:
-        grad_fn = jax.grad(cost_fn)
+        vg_fn = jax.value_and_grad(cost_fn)
+    else:
+        def vg_fn(x):
+            return cost_fn(x), grad_fn(x)
     fresh = memory is None
     if fresh:
         memory = LBFGSMemory.init(n, M, p0.dtype)
 
-    g0 = grad_fn(p0)
+    f0, g0 = vg_fn(p0)
     gradnrm0 = jnp.linalg.norm(g0)
 
     # minibatch batch-switch bookkeeping (lbfgs.c:794-826): runs once per
@@ -195,16 +208,16 @@ def lbfgs_fit(
         alphabar = jnp.asarray(1.0, p0.dtype)
 
     def cond(state):
-        ck, x, g, gradnrm, mem, done = state
+        ck, x, f, g, gradnrm, mem, done = state
         return (ck < itmax) & (~done)
 
     def body(state):
-        ck, x, g, gradnrm, mem, done = state
+        ck, x, f, g, gradnrm, mem, done = state
         pk = _two_loop_direction(g, mem)
-        alphak = armijo_backtrack(cost_fn, x, pk, g, alphabar)
+        alphak = armijo_backtrack(cost_fn, x, pk, g, alphabar, fold=f)
         step_ok = jnp.isfinite(alphak) & (jnp.abs(alphak) >= CLM_EPSILON)
         x1 = x + alphak * pk
-        g1 = grad_fn(x1)
+        f1, g1 = vg_fn(x1)
         gradnrm1 = jnp.linalg.norm(g1)
         grad_ok = jnp.isfinite(gradnrm1) & (gradnrm1 > CLM_STOP_THRESH)
 
@@ -246,16 +259,19 @@ def lbfgs_fit(
         mem1 = mem1.replace(niter=mem.niter + 1)
         # only advance when the step was usable
         x_next = jnp.where(step_ok, x1, x)
+        f_next = jnp.where(step_ok, f1, f)
         g_next = jnp.where(step_ok, g1, g)
         gradnrm_next = jnp.where(step_ok, gradnrm1, gradnrm)
         done_next = (~step_ok) | (~grad_ok)
-        return ck + 1, x_next, g_next, gradnrm_next, mem1, done_next
+        return ck + 1, x_next, f_next, g_next, gradnrm_next, mem1, done_next
 
     from sagecal_tpu.utils.platform import match_vma
 
     start_done = ~(jnp.isfinite(gradnrm0) & (gradnrm0 > CLM_STOP_THRESH))
-    ck, x, g, gradnrm, mem, _ = jax.lax.while_loop(
+    ck, x, f, g, gradnrm, mem, _ = jax.lax.while_loop(
         cond, body,
-        match_vma((jnp.asarray(0), p0, g0, gradnrm0, memory, start_done), p0),
+        match_vma((jnp.asarray(0), p0, f0, g0, gradnrm0, memory,
+                   start_done), p0),
     )
-    return LBFGSResult(p=x, memory=mem, cost=cost_fn(x), gradnorm=gradnrm, iterations=ck)
+    return LBFGSResult(p=x, memory=mem, cost=f, gradnorm=gradnrm,
+                       iterations=ck)

@@ -197,6 +197,9 @@ class TestDriverSpatialExtensions:
         joined = "\n".join(logs)
         assert "MDL: best order" in joined
         assert "spatial basis shapelet" in joined
+        # end-of-run spatial amplitude plot (master PPM output analog)
+        assert os.path.exists(solf + ".spatial.ppm")
+        assert open(solf + ".spatial.ppm", "rb").read(2) == b"P6"
 
     def test_sharmonic_basis_driver(self, tmp_path, devices8):
         """Same driver path with the spherical-harmonic basis."""
@@ -221,3 +224,5 @@ class TestDriverSpatialExtensions:
         dres, pres = traces[0]
         assert np.all(np.isfinite(dres)) and pres[-1] < 0.25
         assert "spatial basis sharmonic" in "\n".join(logs)
+        # sharmonic basis -> no shapelet-series PPM plot
+        assert not os.path.exists(solf + ".spatial.ppm")
