@@ -282,7 +282,7 @@ def test_anchor_two_cluster_ladder_crossing():
     rungs = [
         dict(max_emiter=8, max_iter=40, max_lbfgs=60),
         dict(max_emiter=16, max_iter=80, max_lbfgs=160),
-        dict(max_emiter=24, max_iter=120, max_lbfgs=300),
+        dict(max_emiter=32, max_iter=160, max_lbfgs=400),
     ]
     rms_curve = []
     truth_curve = []
@@ -299,7 +299,11 @@ def test_anchor_two_cluster_ladder_crossing():
     msg = (f"ladder ref-vs-ours {rms_curve}, "
            f"(ref,ours)-vs-truth {truth_curve}")
     assert rms_curve[1] < rms_curve[0] and rms_curve[2] < rms_curve[1], msg
-    assert rms_curve[-1] < 1e-5, msg
+    # at full convergence the two implementations agree far below the
+    # BASELINE.md 1e-6 Jones-RMS bar (measured 1.28e-10 at this rung,
+    # ref res_1 9.5e-14 / ours 5.6e-13: the round-3 2e-4 figure was EM
+    # depth, not a disagreement floor)
+    assert rms_curve[-1] < 1e-6, msg
 
 
 @pytest.mark.slow
