@@ -113,6 +113,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--mdl", action="store_true",
                     help="score consensus polynomial orders by AIC/MDL "
                     "each tile (ref master -M, mdl.c)")
+    ap.add_argument("--federated-alpha", type=float, default=5.0,
+                    help="federated Z~Zavg coupling strength for the "
+                    "-f + -N stochastic mode (ref alpha, "
+                    "find_prod_inverse_full_fed)")
     ap.add_argument("-i", "--influence", action="store_true",
                     help="write influence-function diagnostics instead of "
                     "residuals (ref -i)")
@@ -180,7 +184,20 @@ def main(argv=None):
     cfg = config_from_args(args)
     # mode dispatch (main.cpp:295-307; -f selects the sagecal-mpi
     # equivalent, MPI/main.cpp:336)
-    if args.band_pattern:
+    if args.band_pattern and cfg.epochs > 0:
+        # sagecal-mpi -N > 0: federated stochastic mode
+        # (MPI/main.cpp:353-366 dispatch)
+        from sagecal_tpu.apps.federated import run_federated
+
+        cfg.dataset = args.band_pattern
+        run_federated(
+            cfg,
+            nadmm=max(cfg.admm_iters, 2),
+            epochs=cfg.epochs,
+            minibatches=max(cfg.minibatches, 1),
+            alpha=args.federated_alpha,
+        )
+    elif args.band_pattern:
         from sagecal_tpu.apps.distributed import run_distributed
 
         cfg.dataset = args.band_pattern

@@ -81,3 +81,24 @@ class TestFederatedDriver:
             meta, jsol = solio.read_solutions(
                 str(tmp_path / f"rsol.txt.band{i}"))
             assert np.isfinite(jsol).all(), f"band {i} poisoned"
+
+
+@pytest.mark.slow
+def test_cli_dispatch_federated(tmp_path, devices8):
+    """`-f pattern -N epochs` must select the federated stochastic mode
+    (MPI/main.cpp:353-366 dispatch) end-to-end through the CLI."""
+    from sagecal_tpu.apps.cli import main as cli_main
+
+    Nf = 4
+    paths, sky = _make_bands(tmp_path, Nf=Nf, ntime=2)
+    solf = str(tmp_path / "csol.txt")
+    rc = cli_main([
+        "-d", "x.h5", "-s", str(sky), "-c", str(sky) + ".cluster",
+        "-f", str(tmp_path / "band*.h5"), "-N", "1", "-M", "2",
+        "-t", "2", "-A", "2", "-P", "2", "-p", solf,
+        "--federated-alpha", "5",
+    ])
+    assert rc in (0, None)
+    for i in range(Nf):
+        meta, jsol = solio.read_solutions(f"{solf}.band{i}")
+        assert np.isfinite(jsol).all()
