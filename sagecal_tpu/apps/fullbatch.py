@@ -156,10 +156,14 @@ def run_fullbatch(cfg: RunConfig, log=print):
             return build_cluster_data(dat, clusters, nchunks, fdelta=fdelta,
                                       shapelets=shapelets)
         geom, pointing, coeff, mode, wideband = beam
+        # ALO (lunar) element: no terrestrial J2000 precession
+        # (fullbatch_mode.cpp:335 beam.elType!=ELEM_ALO gate)
+        is_alo = (cfg.element_coeffs or "").lower() == "alo"
         return build_cluster_data_withbeam(
             dat, clusters, nchunks, geom, pointing, coeff, mode,
             ds.time_jd(t0, dat.tilesz), meta.ra0, meta.dec0,
             fdelta=fdelta, wideband=wideband, shapelets=shapelets,
+            precess=not is_alo,
         )
 
     # first-class profiling (SURVEY section 5): per-phase wall-clock

@@ -154,3 +154,25 @@ def lmn_to_radec(ll, mm, ra0, dec0):
     dec = np.arcsin(np.clip(mm * cd0 + n * sd0, -1.0, 1.0))
     ra = ra0 + np.arctan2(ll, n * cd0 - mm * sd0)
     return ra, dec
+
+
+def precess_radec_equatorial(ra, dec, Tr):
+    """Precess J2000 (ra, dec) [rad] with the STANDARD equatorial
+    spherical convention — the application path's source/pointing
+    precession (``Data::precess_source_locations``,
+    src/MS/data.cpp:1616-1645, casacore IAU2000).  The casacore
+    version composes precession with nutation; the nutation term
+    (<= ~9 arcsec) is omitted here, small against the ~20 arcmin/26 yr
+    precession it corrects.  Contrast :func:`precess_radec`, which
+    reproduces transforms.c:268's pole-referenced convention
+    byte-for-byte for the sky-model path."""
+    ra = np.asarray(ra, np.float64)
+    dec = np.asarray(dec, np.float64)
+    pos = np.stack(
+        [np.cos(dec) * np.cos(ra), np.cos(dec) * np.sin(ra),
+         np.broadcast_to(np.sin(dec), np.shape(ra))], axis=-1
+    )
+    p2 = pos @ np.asarray(Tr).T
+    ra2 = np.arctan2(p2[..., 1], p2[..., 0])
+    dec2 = np.arcsin(np.clip(p2[..., 2], -1.0, 1.0))
+    return ra2, dec2

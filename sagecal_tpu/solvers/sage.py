@@ -249,6 +249,7 @@ def build_cluster_data_withbeam(
     fdelta: Optional[float] = None,
     wideband: bool = False,
     shapelets=None,
+    precess: bool = True,
 ) -> ClusterData:
     """Beam-aware tile precompute: per cluster, evaluate the station beam
     toward each source and fold it into the coherencies
@@ -258,16 +259,38 @@ def build_cluster_data_withbeam(
     ``geom``/``pointing``/``coeff``: see :mod:`sagecal_tpu.ops.beam`;
     ``time_jd``: (tilesz,) Julian dates of the tile's timeslots; source
     (ra, dec) are recovered from the batches' direction cosines about
-    (ra0, dec0)."""
+    (ra0, dec0).
+
+    ``precess``: precess source and pointing directions from J2000 to
+    the tile's mid-time epoch before the az/el conversion — the app's
+    ``precess_source_locations`` step (fullbatch_mode.cpp:335-338,
+    data.cpp:1616-1645; skipped for the lunar ALO element, matching
+    ``beam.elType!=ELEM_ALO``)."""
     from sagecal_tpu.ops.beam import beam_jones, predict_coherencies_withbeam
-    from sagecal_tpu.ops.transforms import lmn_to_radec
+    from sagecal_tpu.ops.transforms import (
+        get_precession_params, lmn_to_radec, precess_radec_equatorial,
+    )
 
     if fdelta is None:
         fdelta = data.deltaf
+    Tr = None
+    if precess:
+        jd = np.asarray(time_jd)
+        Tr = get_precession_params(float(jd[len(jd) // 2]))
+        pra, pdec = precess_radec_equatorial(pointing.ra0, pointing.dec0, Tr)
+        bra, bdec = precess_radec_equatorial(
+            pointing.b_ra0, pointing.b_dec0, Tr
+        )
+        pointing = pointing._replace(
+            ra0=float(pra), dec0=float(pdec),
+            b_ra0=float(bra), b_dec0=float(bdec),
+        )
     cohs = []
     cmaps = []
     for src, nch in zip(clusters, nchunks):
         ra, dec = lmn_to_radec(np.asarray(src.ll), np.asarray(src.mm), ra0, dec0)
+        if Tr is not None:
+            ra, dec = precess_radec_equatorial(ra, dec, Tr)
         B = beam_jones(
             geom, pointing, coeff, ra, dec, np.asarray(time_jd),
             jnp.asarray(data.freqs), mode=beam_mode, wideband=wideband,
