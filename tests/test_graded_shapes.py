@@ -32,6 +32,16 @@ def _mem_bytes(compiled):
             + ma.temp_size_in_bytes)
 
 
+@pytest.fixture(autouse=True)
+def _release_executables():
+    """These tests compile multi-GB programs; drop every cached
+    executable afterwards so the rest of a combined suite run does not
+    inherit their footprint (a full slow-suite run crashed on
+    accumulated peak memory without this)."""
+    yield
+    jax.clear_caches()
+
+
 def _sds_like(tree):
     return jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), tree
