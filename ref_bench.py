@@ -53,7 +53,9 @@ def main():
         print(json.dumps({"error": "reference library unavailable"}))
         return 1
 
-    data, cdata, p0 = bench.build_workload(dtype=np.float64)
+    tilesz_req = int(os.environ.get("REF_BENCH_TILESZ", bench.TILESZ))
+    data, cdata, p0 = bench.build_workload(dtype=np.float64,
+                                           tilesz=tilesz_req)
     rows = data.vis.shape[-1]
     nbase = data.nbase
     tilesz = data.tilesz
