@@ -198,22 +198,37 @@ def run_fullbatch(cfg: RunConfig, log=print):
                                  specs, cfg.tilesz, depth=1)
     try:
       prefetch = iter(prefetch_cm.__enter__())
-      for tile_no, t0 in pairs:
+
+      def _prepare(t0):
+          """Load + coherency precompute for one tile.  All device
+          work here is ASYNC jit dispatch, so calling this right after
+          dispatching the previous tile's solve overlaps the coherency
+          precompute with the device solve (the same software pipeline
+          as the distributed driver; the reference's threaded per-tile
+          precompute role, fullbatch_mode.cpp:371-388).  Coherencies
+          depend only on u/v/w/freqs, so whitening (vis/mask-only) can
+          be applied later without invalidating them."""
+          t0_chk, tiles = next(prefetch)
+          if t0_chk != t0:
+              raise RuntimeError(
+                  f"prefetch order mismatch: got tile {t0_chk}, "
+                  f"expected {t0}"
+              )
+          full_ = tiles[0]
+          data_ = None if cfg.simulation_mode else tiles[1]
+          cdata_full_ = _cdata(
+              full_, t0, fdelta=meta.deltaf / max(meta.nchan, 1)
+          )
+          cdata_ = None if cfg.simulation_mode else _cdata(data_, t0)
+          return full_, data_, cdata_full_, cdata_
+
+      prepared = None
+      if pairs:
+          with timer.phase("load+coh"):
+              prepared = _prepare(pairs[0][1])
+      for pi, (tile_no, t0) in enumerate(pairs):
         tic = time.time()
-        with timer.phase("load"):
-            t0_chk, tiles = next(prefetch)
-            if t0_chk != t0:
-                raise RuntimeError(
-                    f"prefetch order mismatch: got tile {t0_chk}, "
-                    f"expected {t0}"
-                )
-            full = tiles[0]
-            if not cfg.simulation_mode:
-                data = tiles[1]
-        with timer.phase("coherencies"):
-            cdata_full = _cdata(
-                full, t0, fdelta=meta.deltaf / max(meta.nchan, 1)
-            )
+        full, data, cdata_full, cdata = prepared
 
         if cfg.simulation_mode:
             # predict / add / subtract (fullbatch_mode.cpp:536-591);
@@ -231,6 +246,9 @@ def run_fullbatch(cfg: RunConfig, log=print):
                 ignore_clusters=ignore_idx, ccid_index=ccid_index,
                 rho=cfg.correction_rho, phase_only=cfg.phase_only_correction,
             )
+            if pi + 1 < len(pairs):
+                with timer.phase("load+coh"):
+                    prepared = _prepare(pairs[pi + 1][1])
             ds.write_tile(t0, np.asarray(mat_of_flat(out_vis)), column="model")
             log(f"tile {t0}: simulated ({time.time()-tic:.1f}s)")
             continue
@@ -239,11 +257,14 @@ def run_fullbatch(cfg: RunConfig, log=print):
             wts = jnp.sqrt(whiten_uv_weights(data.u, data.v, meta.freq0))
             data = data.replace(vis=data.vis * wts[None, None, :],
                                 mask=data.mask * (wts[None, :] > 0))
-        with timer.phase("coherencies"):
-            cdata = _cdata(data, t0)
-
         with timer.phase("solve"):
-            out = sagefit(data, cdata, p, scfg)
+            out = sagefit(data, cdata, p, scfg)  # async dispatch
+        # overlap: next tile's load + coherency dispatch runs while the
+        # device solves this tile
+        if pi + 1 < len(pairs):
+            with timer.phase("load+coh"):
+                prepared = _prepare(pairs[pi + 1][1])
+        with timer.phase("solve-wait"):
             res0, res1 = float(out.res_0), float(out.res_1)
         # divergence guard (fullbatch_mode.cpp:618-632)
         diverged = (

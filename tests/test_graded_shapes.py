@@ -209,3 +209,79 @@ def test_config5_ska_scale_sharded_compiles_and_fits_hbm(devices8):
     print(f"config5 compiled: {total/1e9:.2f} GB total, "
           f"~{per_dev/1e9:.2f} GB/device sharded estimate")
     assert per_dev < HBM_BYTES, f"{per_dev/1e9:.2f} GB/dev exceeds 16 GB"
+
+
+@pytest.mark.slow
+def test_config2_stochastic_bandpass_100_clusters(tmp_path, devices8):
+    """Graded config 2 (BASELINE.md): stochastic minibatch LBFGS
+    bandpass on a single dataset with 100 clusters and the Student's-t
+    noise model (solver mode 2 -> robust minibatch cost), run FOR REAL
+    through the minibatch application at reduced time depth."""
+    import math
+
+    from sagecal_tpu.apps.config import RunConfig
+    from sagecal_tpu.apps.minibatch import run_minibatch
+    from sagecal_tpu.io.dataset import simulate_dataset
+    from sagecal_tpu.io.simulate import random_jones
+    from sagecal_tpu.ops.rime import point_source_batch
+
+    rng = np.random.default_rng(9)
+    M, N = 100, 62
+    f0 = 150e6
+    clusters = [
+        point_source_batch([rng.uniform(-0.05, 0.05)],
+                           [rng.uniform(-0.05, 0.05)],
+                           [rng.uniform(0.5, 3.0)], f0=f0,
+                           dtype=jnp.float64)
+        for _ in range(M)
+    ]
+    jones = random_jones(M, N, seed=2, amp=0.1, dtype=np.complex128)
+    dsp = str(tmp_path / "c2.h5")
+    simulate_dataset(dsp, nstations=N, ntime=2, nchan=2, freq0=f0,
+                     clusters=clusters, jones=jones, noise_sigma=1e-3,
+                     seed=1, dec0=0.9)
+    sky = tmp_path / "c2.sky"
+    lines = []
+    cl_lines = []
+    for k in range(M):
+        # positions don't need to match the simulated ones for the
+        # program-shape claim; reuse the simulated clusters' lmn by
+        # writing a sky whose predict reproduces them is overkill here
+        pass
+    # calibrate against the TRUE simulated clusters via the library
+    # setup that run_minibatch uses, by writing a matching sky model
+    from sagecal_tpu.ops.transforms import lmn_to_radec
+
+    def _fmt(ra, dec, flux):
+        h = (ra % (2 * math.pi)) * 12 / math.pi
+        hh = int(h); hm = int((h - hh) * 60); hs = ((h - hh) * 60 - hm) * 60
+        s = -1 if dec < 0 else 1
+        d = abs(dec) * 180 / math.pi
+        dd = int(d); dm = int((d - dd) * 60); ds = ((d - dd) * 60 - dm) * 60
+        return (f"P{len(lines)} {hh} {hm} {hs:.6f} {s*dd} {dm} {ds:.6f} "
+                f"{flux:.6f} 0 0 0 0 0 0 0 0 150e6")
+
+    for k, c in enumerate(clusters):
+        ra, dec = lmn_to_radec(float(c.ll[0]), float(c.mm[0]), 0.0, 0.9)
+        lines.append(_fmt(float(ra), float(dec), float(c.sI0[0])))
+        cl_lines.append(f"{k + 1} 1 P{k}")
+    sky.write_text("\n".join(lines) + "\n")
+    (tmp_path / "c2.sky.cluster").write_text("\n".join(cl_lines) + "\n")
+
+    cfg = RunConfig(
+        dataset=dsp, sky_model=str(sky),
+        cluster_file=str(tmp_path / "c2.sky.cluster"),
+        out_solutions=str(tmp_path / "c2sol.txt"),
+        tilesz=2, epochs=1, minibatches=2, bands=1,
+        max_lbfgs=6, lbfgs_m=7, solver_mode=2,  # robust Student's-t
+        nulow=2.0, nuhigh=30.0,
+    )
+    out = run_minibatch(cfg, log=lambda *a: None)
+    assert len(out) == 1
+    r0, r1 = out[0]
+    assert np.isfinite(r1) and r1 < r0, (r0, r1)
+    # solutions file parses at the 100-cluster width
+    from sagecal_tpu.io import solutions as solio
+
+    meta, jsol = solio.read_solutions(str(tmp_path / "c2sol.txt"))
+    assert jsol.shape[1] == M and np.isfinite(jsol).all()
