@@ -88,9 +88,19 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--multihost", action="store_true",
                     help="call jax.distributed.initialize() for multi-host "
                     "meshes (DCN)")
-    ap.add_argument("-U", "--spatial-n0", type=int, default=0,
+    ap.add_argument("-U", "--global-residual", type=int, default=0,
+                    help="if >0, compute final residuals from the GLOBAL "
+                    "consensus solution B_f Z instead of the per-band "
+                    "solutions (ref -U use_global_solution, "
+                    "sagecal_slave.cpp:861-979)")
+    ap.add_argument("-X", "--spatialreg", default=None,
+                    metavar="lam,mu,n0,fista_maxiter,cadence",
+                    help="enable spatial regularization with these "
+                    "parameters (ref -X; overrides the individual "
+                    "--spatial-* flags)")
+    ap.add_argument("--spatial-n0", type=int, default=0,
                     help=">0 enables spatial regularization of Z with a "
-                    "shapelet basis of this order (ref -U)")
+                    "basis of this order (the -X n0 component)")
     ap.add_argument("--spatial-beta", type=float, default=0.01,
                     help="shapelet basis scale; <=0 uses the master's "
                     "auto scale 4*sqrt(l_max^2/M)")
@@ -201,18 +211,36 @@ def main(argv=None):
         from sagecal_tpu.apps.distributed import run_distributed
 
         cfg.dataset = args.band_pattern
+        sp_n0 = args.spatial_n0
+        sp_mu = args.spatial_mu
+        sp_lam = args.spatial_lam
+        sp_iters, sp_cadence = 30, args.spatial_cadence
+        if args.spatialreg:
+            # -X lam,mu,n0,fista_maxiter,cadence (MPI/main.cpp:102)
+            parts = args.spatialreg.split(",")
+            if len(parts) != 5:
+                ap = build_parser()
+                ap.error(
+                    f"-X expects 5 comma-separated values "
+                    f"lam,mu,n0,fista_maxiter,cadence, got {args.spatialreg!r}"
+                )
+            lam_s, mu_s, n0_s, it_s, cad_s = parts
+            sp_lam, sp_mu = float(lam_s), float(mu_s)
+            sp_n0, sp_iters, sp_cadence = int(n0_s), int(it_s), int(cad_s)
         run_distributed(
             cfg, multihost=args.multihost,
             nadmm=max(cfg.admm_iters, 2),
-            spatial_n0=args.spatial_n0,
+            spatial_n0=sp_n0,
             spatial_beta=args.spatial_beta,
-            spatial_mu=args.spatial_mu,
-            spatial_cadence=args.spatial_cadence,
+            spatial_mu=sp_mu,
+            spatial_cadence=sp_cadence,
+            spatial_fista_maxiter=sp_iters,
             spatial_basis=args.spatial_basis,
             spatial_diffuse_id=args.spatial_diffuse_id,
             spatial_gamma=args.spatial_gamma,
-            spatial_lam=args.spatial_lam,
+            spatial_lam=sp_lam,
             mdl=args.mdl,
+            global_residual=bool(args.global_residual),
         )
     elif cfg.epochs > 0:
         from sagecal_tpu.apps.minibatch import run_minibatch

@@ -109,7 +109,9 @@ def run_distributed(
     spatial_diffuse_id: Optional[int] = None,
     spatial_gamma: float = 0.0,
     spatial_lam: float = 0.0,
+    spatial_fista_maxiter: int = 30,
     mdl: bool = False,
+    global_residual: bool = False,
 ):
     """Calibrate a multi-band observation on the device mesh.
 
@@ -152,7 +154,8 @@ def run_distributed(
             cfg, datasets, handles, open_files, log, nadmm, dtype,
             spatial_n0, spatial_beta, spatial_mu, spatial_alpha,
             spatial_cadence, spatial_basis, spatial_diffuse_id,
-            spatial_gamma, spatial_lam, mdl,
+            spatial_gamma, spatial_lam, mdl, spatial_fista_maxiter,
+            global_residual,
         )
     finally:
         for fh in open_files:
@@ -171,7 +174,8 @@ def _run_distributed_inner(
     cfg, datasets, handles, open_files, log, nadmm, dtype,
     spatial_n0, spatial_beta, spatial_mu, spatial_alpha, spatial_cadence,
     spatial_basis="shapelet", spatial_diffuse_id=None, spatial_gamma=0.0,
-    spatial_lam=0.0, mdl=False,
+    spatial_lam=0.0, mdl=False, spatial_fista_maxiter=30,
+    global_residual=False,
 ):
     metas = [h.meta for h in handles]
     ntime = _check_band_consistency(metas, log)
@@ -266,6 +270,7 @@ def _run_distributed_inner(
                 np.where(alpha_m > 0, alpha_m, cfg.admm_rho), dtype
             ),
             mu=spatial_mu, cadence=spatial_cadence,
+            fista_maxiter=spatial_fista_maxiter,
             Z_diff0=Z_diff0, gamma=spatial_gamma, lam_diff=spatial_lam,
         )
 
@@ -442,8 +447,16 @@ def _run_distributed_inner(
                 M * nchunk_max, N, 2, 2
             )
             solio.append_solutions(band_fhs[i], jsol)
+            # -U: residuals from the GLOBAL consensus solution B_f Z
+            # instead of the per-band J (sagecal_slave.cpp:861-979
+            # use_global_solution path)
+            p_res = out.p[i]
+            if global_residual:
+                p_res = consensus.bz_for_freq(
+                    out.Z, jnp.asarray(B_pad[i], dtype)
+                ).reshape(M, nchunk_max, n8)
             res = calculate_residuals(
-                datas[i], cdatas[i], out.p[i],
+                datas[i], cdatas[i], p_res,
             )
             handles[i].write_tile(
                 t0, np.asarray(mat_of_flat(res)), column="corrected"

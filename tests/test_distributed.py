@@ -226,3 +226,31 @@ class TestDriverSpatialExtensions:
         assert "spatial basis sharmonic" in "\n".join(logs)
         # sharmonic basis -> no shapelet-series PPM plot
         assert not os.path.exists(solf + ".spatial.ppm")
+
+
+@pytest.mark.slow
+class TestGlobalResidualAndXFlag:
+    def test_cli_global_residual_and_spatialreg(self, tmp_path, devices8):
+        """-U 1 (use_global_solution residuals, slave:861-979) and
+        -X lam,mu,n0,iters,cadence (MPI/main.cpp:102) through the CLI."""
+        from sagecal_tpu.apps.cli import main as cli_main
+
+        Nf = 4
+        paths, sky = _make_bands(tmp_path, Nf=Nf, ntime=2)
+        solf = str(tmp_path / "gsol.txt")
+        rc = cli_main([
+            "-d", "x.h5", "-s", str(sky), "-c", str(sky) + ".cluster",
+            "-f", str(tmp_path / "band*.h5"), "-t", "2", "-e", "1",
+            "-g", "6", "-A", "4", "-P", "2", "-p", solf,
+            "-U", "1", "-X", "1e-3,1e-4,2,20,2",
+        ])
+        assert rc in (0, None)
+        # residual write-back ran with the global solution and stayed
+        # smaller than the raw data (the consensus fit is good here)
+        with h5py.File(paths[0], "r") as fh:
+            res = np.asarray(fh["corrected"])
+            vis = np.asarray(fh["vis"])
+            assert np.isfinite(res).all()
+            assert np.linalg.norm(res) < 0.6 * np.linalg.norm(vis)
+        # spatial path engaged (the -X n0=2 order): PPM plot emitted
+        assert os.path.exists(solf + ".spatial.ppm")

@@ -26,7 +26,8 @@ if [ "$HEALTHY" != 1 ]; then
   exit 1
 fi
 
-# (a) bank the plain bench
+# (a) bank the plain bench (persistent compile cache speeds retries)
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
 echo "=== banking plain TPU bench at $(date)" >> "$LOG"
 timeout 900 python bench.py > /root/repo/bench_tpu_r04.json 2>/root/repo/bench_tpu_r04.err
 if grep -q '"platform": "tpu"' /root/repo/bench_tpu_r04.json && \
