@@ -31,6 +31,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="output solutions file")
     ap.add_argument("-q", "--init-solutions", default=None,
                     help="initial solutions (warm start)")
+    ap.add_argument("-I", "--in-column", default="vis",
+                    help="input dataset column: vis/corrected/model/... "
+                    "(ref -I DATA/CORRECTED_DATA)")
+    ap.add_argument("--out-column", default="corrected",
+                    help="output dataset column for residuals "
+                    "(ref -O OutField; -O is taken by spatial cadence)")
+    ap.add_argument("-F", "--sky-format", type=int, default=-1,
+                    choices=(-1, 0, 1),
+                    help="sky model format: 0 LSM, 1 three-term spectra, "
+                    "-1 auto-detect (ref -F)")
     ap.add_argument("-t", "--tilesz", type=int, default=120)
     ap.add_argument("-e", "--max-emiter", type=int, default=3)
     ap.add_argument("-g", "--max-iter", type=int, default=2)
@@ -164,6 +174,9 @@ def config_from_args(args) -> RunConfig:
         phase_only_correction=args.phase_only_correction,
         epochs=args.epochs,
         minibatches=args.minibatches,
+        in_column=args.in_column,
+        out_column=args.out_column,
+        sky_format=args.sky_format,
         bands=args.bands,
         admm_iters=args.admm_iters,
         npoly=args.npoly,

@@ -41,6 +41,9 @@ class RunConfig:
     # stochastic modes
     epochs: int = 0  # -N  (>0 selects minibatch mode)
     minibatches: int = 1  # -M
+    in_column: str = "vis"  # -I input column (data.h DataField)
+    out_column: str = "corrected"  # --out-column (ref -O OutField)
+    sky_format: int = -1  # -F: -1 auto, 0 LSM, 1 three-term spectra
     bands: int = 1  # -w mini-bands
     admm_iters: int = 0  # -A (>0 with bands>1 selects consensus)
     npoly: int = 2  # -P

@@ -185,7 +185,8 @@ def _run_distributed_inner(
     freq0 = float(np.mean(freqs))
 
     clusters, cdefs, shapelets = load_sky(
-        cfg.sky_model, cfg.cluster_file, meta0.ra0, meta0.dec0, dtype=dtype
+        cfg.sky_model, cfg.cluster_file, meta0.ra0, meta0.dec0, dtype=dtype,
+        three_term_spectra=None if cfg.sky_format < 0 else bool(cfg.sky_format),
     )
     M = len(clusters)
     nchunks = [cd.nchunk for cd in cdefs]
@@ -314,7 +315,8 @@ def _run_distributed_inner(
     # while the mesh ADMM solves the current one (TilePrefetcher,
     # io/dataset.py — the fullbatch loop's loadData-overlap role).
     spec = [dict(average_channels=True, min_uvcut=cfg.min_uvcut,
-                 max_uvcut=cfg.max_uvcut, dtype=dtype)]
+                 max_uvcut=cfg.max_uvcut, dtype=dtype,
+                 column=cfg.in_column)]
     full_t0s = [t0 for _, t0 in pairs
                 if min(cfg.tilesz, ntime - t0) == cfg.tilesz]
     prefetchers = [
@@ -459,7 +461,7 @@ def _run_distributed_inner(
                 datas[i], cdatas[i], p_res,
             )
             handles[i].write_tile(
-                t0, np.asarray(mat_of_flat(res)), column="corrected"
+                t0, np.asarray(mat_of_flat(res)), column=cfg.out_column
             )
         traces.append(
             (np.asarray(out.dual_res), np.asarray(out.primal_res))

@@ -116,7 +116,8 @@ def _run_inner(cfg, datasets, handles, open_files, log, nadmm, epochs,
     freq0 = float(np.mean(freqs))
 
     clusters, cdefs, shapelets = load_sky(
-        cfg.sky_model, cfg.cluster_file, meta0.ra0, meta0.dec0, dtype=dtype
+        cfg.sky_model, cfg.cluster_file, meta0.ra0, meta0.dec0, dtype=dtype,
+        three_term_spectra=None if cfg.sky_format < 0 else bool(cfg.sky_format),
     )
     M = len(clusters)
     nchunks = [cd.nchunk for cd in cdefs]
@@ -157,7 +158,8 @@ def _run_inner(cfg, datasets, handles, open_files, log, nadmm, epochs,
     state = init_federated_state(Nf, M, nchunk_max, n8, cfg.npoly,
                                  cfg.lbfgs_m or 7, dtype)
     spec = dict(average_channels=True, min_uvcut=cfg.min_uvcut,
-                max_uvcut=cfg.max_uvcut, dtype=dtype)
+                max_uvcut=cfg.max_uvcut, dtype=dtype,
+                column=cfg.in_column)
 
     from sagecal_tpu.parallel.mesh import stack_for_mesh
 

@@ -107,7 +107,8 @@ def run_fullbatch(cfg: RunConfig, log=print):
     ds = VisDataset(cfg.dataset, "r+")
     meta = ds.meta
     clusters, cdefs, shapelets = load_sky(
-        cfg.sky_model, cfg.cluster_file, meta.ra0, meta.dec0, dtype=dtype
+        cfg.sky_model, cfg.cluster_file, meta.ra0, meta.dec0, dtype=dtype,
+        three_term_spectra=None if cfg.sky_format < 0 else bool(cfg.sky_format),
     )
     M = len(clusters)
     nchunks = [cd.nchunk for cd in cdefs]
@@ -185,7 +186,7 @@ def run_fullbatch(cfg: RunConfig, log=print):
     if cfg.max_tiles:
         pairs = pairs[: cfg.max_tiles]
     load_kw = dict(min_uvcut=cfg.min_uvcut, max_uvcut=cfg.max_uvcut,
-                   dtype=dtype)
+                   dtype=dtype, column=cfg.in_column)
     specs = [dict(average_channels=False, **load_kw)]
     if not cfg.simulation_mode:
         specs.append(dict(average_channels=True, **load_kw))
@@ -330,7 +331,7 @@ def run_fullbatch(cfg: RunConfig, log=print):
                     phase_only=cfg.phase_only_correction,
                 )))
         with timer.phase("write"):
-            ds.write_tile(t0, np.asarray(res), column="corrected")
+            ds.write_tile(t0, np.asarray(res), column=cfg.out_column)
         log(
             f"tile {t0}: residual {res0:.6f} -> {res1:.6f} "
             f"nu {float(out.mean_nu):.1f} ({time.time()-tic:.1f}s) "

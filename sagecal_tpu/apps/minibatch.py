@@ -59,7 +59,8 @@ def run_minibatch(cfg: RunConfig, log=print):
     ds = VisDataset(cfg.dataset, "r+")
     meta = ds.meta
     clusters, cdefs, shapelets = load_sky(
-        cfg.sky_model, cfg.cluster_file, meta.ra0, meta.dec0, dtype=dtype
+        cfg.sky_model, cfg.cluster_file, meta.ra0, meta.dec0, dtype=dtype,
+        three_term_spectra=None if cfg.sky_format < 0 else bool(cfg.sky_format),
     )
     M = len(clusters)
     nchunks = [cd.nchunk for cd in cdefs]
@@ -128,7 +129,8 @@ def run_minibatch(cfg: RunConfig, log=print):
             tic = time.time()
             full = ds.load_tile(t0, t1 - t0, average_channels=False,
                                 min_uvcut=cfg.min_uvcut,
-                                max_uvcut=cfg.max_uvcut, dtype=dtype)
+                                max_uvcut=cfg.max_uvcut, dtype=dtype,
+                                column=cfg.in_column)
             fd = meta.deltaf / max(meta.nchan, 1)
             if not consensus_mode:
                 for bi, (c0, c1) in enumerate(bands):
@@ -197,7 +199,8 @@ def run_minibatch(cfg: RunConfig, log=print):
         t0, t1 = int(tedges[mb]), int(tedges[mb + 1])
         if t1 <= t0:
             continue
-        full = ds.load_tile(t0, t1 - t0, average_channels=False, dtype=dtype)
+        full = ds.load_tile(t0, t1 - t0, average_channels=False, dtype=dtype,
+                            column=cfg.in_column)
         from sagecal_tpu.core.types import mat_of_flat
 
         res_all = np.array(np.asarray(mat_of_flat(full.vis)), copy=True)
@@ -209,7 +212,7 @@ def run_minibatch(cfg: RunConfig, log=print):
             res_all[:, c0:c1] = np.asarray(mat_of_flat(res))
             acc[bi][0] += float(jnp.sum(jnp.abs(db.vis) ** 2))
             acc[bi][1] += float(jnp.sum(jnp.abs(res) ** 2))
-        ds.write_tile(t0, res_all, column="corrected")
+        ds.write_tile(t0, res_all, column=cfg.out_column)
     results = []
     for bi in range(len(bands)):
         r0, r1 = float(np.sqrt(acc[bi][0])), float(np.sqrt(acc[bi][1]))

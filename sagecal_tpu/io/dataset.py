@@ -100,6 +100,7 @@ class VisDataset:
         min_uvcut: float = 0.0,
         max_uvcut: float = 1e20,
         dtype=np.float64,
+        column: str = "vis",
     ) -> VisData:
         """Load timeslots [t0, t0+tilesz) as a :class:`VisData`.
 
@@ -107,15 +108,25 @@ class VisDataset:
         effective channel = mean over channels with >= nchan/2 unflagged
         (data.cpp:665-700); False returns the raw multichannel data
         (the residual-writing path's view).
+
+        ``column`` selects the input dataset (the reference's -I
+        DATA/CORRECTED_DATA choice, data.h:140-211): 'vis',
+        'corrected', 'model', ... — any (ntime, nbase, nchan, 2, 2)
+        complex dataset in the file.
         """
         f = self._f
         m = self.meta
+        if column not in f:
+            raise KeyError(
+                f"{self.path}: no input column {column!r} "
+                f"(available: {sorted(k for k in f.keys())})"
+            )
         t1 = min(t0 + tilesz, m.ntime)
         nt = t1 - t0
         u = np.asarray(f["u"][t0:t1]).reshape(-1)  # (nt*nbase,)
         v = np.asarray(f["v"][t0:t1]).reshape(-1)
         w = np.asarray(f["w"][t0:t1]).reshape(-1)
-        vis = np.asarray(f["vis"][t0:t1])  # (nt, nbase, nchan, 2, 2)
+        vis = np.asarray(f[column][t0:t1])  # (nt, nbase, nchan, 2, 2)
         flag = np.asarray(f["flag"][t0:t1])  # (nt, nbase, nchan)
         rows = nt * m.nbase
         vis = vis.reshape(rows, m.nchan, 2, 2)

@@ -247,6 +247,7 @@ def load_sky(
     ra0: float,
     dec0: float,
     dtype=np.float32,
+    three_term_spectra=None,
 ) -> tuple[list, list, object]:
     """Full pipeline: files ->
     ([SourceBatch per cluster], [ClusterDef], ShapeletTable | None).
@@ -261,7 +262,7 @@ def load_sky(
 
     from sagecal_tpu.ops.rime import ST_SHAPELET
 
-    sky = parse_skymodel(sky_path)
+    sky = parse_skymodel(sky_path, three_term_spectra)
     cdefs = parse_clusters(cluster_path)
     directory = os.path.dirname(os.path.abspath(sky_path))
     batches = []

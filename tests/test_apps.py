@@ -467,3 +467,56 @@ class TestBeamPrecession:
         # small but resolvable change, far from a sign flip
         rel = float(np.linalg.norm(a - b) / np.linalg.norm(a))
         assert 1e-8 < rel < 0.5, rel
+
+
+class TestColumnSelection:
+    def test_in_out_columns(self, workdir):
+        """-I/-out-column: calibrate from a copied input column and
+        write residuals to a custom output column (the reference's
+        DataField/OutField choice, data.h:140-211)."""
+        import h5py
+
+        dsp = workdir / "dcol.h5"
+        jones = random_jones(2, 7, seed=3, amp=0.1, dtype=np.complex128)
+        _make_dataset(dsp, jones=jones)
+        with h5py.File(str(dsp), "r+") as f:
+            f.create_dataset("datacopy", data=np.asarray(f["vis"]))
+        cfg = RunConfig(
+            dataset=str(dsp), sky_model=str(workdir / "t.sky.txt"),
+            cluster_file=str(workdir / "t.sky.txt.cluster"),
+            out_solutions=str(workdir / "solc.txt"),
+            tilesz=4, max_emiter=2, max_iter=5, max_lbfgs=8,
+            solver_mode=1, in_column="datacopy", out_column="resid2",
+        )
+        out = run_fullbatch(cfg, log=lambda *a: None)
+        assert len(out) == 1 and np.isfinite(out[0][1])
+        with h5py.File(str(dsp), "r") as f:
+            assert "resid2" in f
+            res = np.asarray(f["resid2"])
+            vis = np.asarray(f["vis"])
+            assert np.isfinite(res).all()
+            assert np.linalg.norm(res) < 0.9 * np.linalg.norm(vis)
+
+    def test_missing_in_column_raises(self, workdir):
+        from sagecal_tpu.io.dataset import VisDataset
+
+        dsp = workdir / "dmiss.h5"
+        _make_dataset(dsp)
+        with VisDataset(str(dsp)) as ds:
+            with pytest.raises(KeyError, match="nope"):
+                ds.load_tile(0, 2, column="nope")
+
+
+class TestSkyFormatFlag:
+    def test_forced_formats_differ(self, tmp_path):
+        """-F 0 vs -F 1 on a 19-token line: forced LSM reads RM from
+        the si1 slot (parse contract of readsky.c's -F switch)."""
+        from sagecal_tpu.io.skymodel import parse_skymodel
+
+        line = ("P1 0 0 0 45 0 0 2.0 0 0 0 -0.7 0.1 0.02 0 0 0 0 150e6\n")
+        p = tmp_path / "f.sky"
+        p.write_text(line)
+        s1 = parse_skymodel(str(p), three_term_spectra=True)["P1"]
+        s0 = parse_skymodel(str(p), three_term_spectra=False)["P1"]
+        assert s1.spec_idx1 == 0.1 and s1.spec_idx2 == 0.02
+        assert s0.spec_idx1 == 0.0 and s0.spec_idx == -0.7
