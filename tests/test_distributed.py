@@ -254,3 +254,33 @@ class TestGlobalResidualAndXFlag:
             assert np.linalg.norm(res) < 0.6 * np.linalg.norm(vis)
         # spatial path engaged (the -X n0=2 order): PPM plot emitted
         assert os.path.exists(solf + ".spatial.ppm")
+
+
+@pytest.mark.slow
+def test_distributed_hybrid_chunks(tmp_path, devices8):
+    """Hybrid time-chunking (cluster-file column 2 > 1, lmfit.c:86-87)
+    through the distributed driver: cluster 1 solves 2 sub-intervals of
+    the tile, so the effective-cluster width is M*nchunk_max in both
+    the per-band solution files and the global-Z file."""
+    Nf = 4
+    paths, sky = _make_bands(tmp_path, Nf=Nf, ntime=2)
+    hyb = tmp_path / "h.cluster"
+    hyb.write_text("1 2 P1\n2 1 P2\n")
+    solf = str(tmp_path / "hsol.txt")
+    cfg = RunConfig(
+        dataset=str(tmp_path / "band*.h5"),
+        sky_model=str(sky), cluster_file=str(hyb),
+        out_solutions=solf,
+        tilesz=2, max_emiter=1, max_iter=6, npoly=2,
+        admm_iters=4, admm_rho=10.0, solver_mode=1,
+    )
+    traces = run_distributed(cfg, log=lambda *a: None)
+    dres, pres = traces[0]
+    assert np.all(np.isfinite(dres)) and pres[-1] < 0.3, (dres, pres)
+    # M=2 clusters, nchunk_max=2 -> 4 effective columns
+    meta, jsol = solio.read_solutions(f"{solf}.band0")
+    assert jsol.shape == (1, 4, 7, 2, 2)
+    assert np.isfinite(jsol).all()
+    lines = [ln for ln in open(solf) if not ln.startswith("#")]
+    ncols = len(lines[1].split())
+    assert ncols == 1 + 4  # row index + M*nchunk_max effective columns
