@@ -284,3 +284,27 @@ def test_distributed_hybrid_chunks(tmp_path, devices8):
     lines = [ln for ln in open(solf) if not ln.startswith("#")]
     ncols = len(lines[1].split())
     assert ncols == 1 + 4  # row index + M*nchunk_max effective columns
+
+
+@pytest.mark.slow
+def test_distributed_robust_rtr_mode(tmp_path, devices8):
+    """Driver run with solver_mode=5 (robust RTR + ADMM x-steps) — the
+    reference MPI slave's DEFAULT local solver
+    (rtr_solve_nocuda_robust_admm, sagecal_slave.cpp:764-787)."""
+    Nf = 4
+    paths, sky = _make_bands(tmp_path, Nf=Nf, ntime=2)
+    solf = str(tmp_path / "rrsol.txt")
+    cfg = RunConfig(
+        dataset=str(tmp_path / "band*.h5"),
+        sky_model=str(sky), cluster_file=str(sky) + ".cluster",
+        out_solutions=solf,
+        tilesz=2, max_emiter=1, max_iter=5, npoly=2,
+        admm_iters=4, admm_rho=10.0, solver_mode=5,
+        nulow=2.0, nuhigh=30.0,
+    )
+    traces = run_distributed(cfg, log=lambda *a: None)
+    dres, pres = traces[0]
+    assert np.all(np.isfinite(dres)) and np.all(np.isfinite(pres))
+    assert pres[-1] < 0.3, pres
+    meta, jsol = solio.read_solutions(f"{solf}.band0")
+    assert np.isfinite(jsol).all()
