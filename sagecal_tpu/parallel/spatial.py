@@ -28,6 +28,17 @@ FISTA_L_MIN = 1e-9
 FISTA_L_MAX = 1e9
 
 
+def _cdtype():
+    """Widest complex dtype the process supports: complex128 under x64,
+    complex64 otherwise (a hard c128 request in a non-x64 process only
+    earns a truncation warning and silently runs c64 anyway)."""
+    return jnp.complex128 if jax.config.jax_enable_x64 else jnp.complex64
+
+
+def _fdtype():
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
 def _assoc_legendre(l: int, m: int, x):
     """Associated Legendre P_l^m(x) with the Condon-Shortley phase, by
     the standard recurrence (elementbeam.c:560-588 ``P``)."""
@@ -120,9 +131,9 @@ def spatial_basis_modes(ll, mm, n0: int, beta: Optional[float] = None,
 def basis_blocks(modes) -> jax.Array:
     """Mode matrix (M, G) -> per-cluster blocks Phi_k = kron(phi_k, I_2):
     (M, 2G, 2), rows ordered (g, i) (sagecal_master.cpp:408-414)."""
-    modes = jnp.asarray(modes, jnp.complex128)
+    modes = jnp.asarray(modes, _cdtype())
     M, G = modes.shape
-    eye = jnp.eye(2, dtype=jnp.complex128)
+    eye = jnp.eye(2, dtype=modes.dtype)
     Phi = jnp.einsum("mg,ij->mgij", modes, eye)
     return Phi.reshape(M, 2 * G, 2)
 
@@ -278,4 +289,4 @@ def bz_spatial(Zs, B_f, N: int) -> jax.Array:
     Zs = jnp.asarray(Zs)
     Npoly = B_f.shape[-1]
     blocks = Zs.reshape(Npoly, 2 * N, Zs.shape[-1])
-    return jnp.einsum("p,pij->ij", jnp.asarray(B_f, jnp.float64), blocks)
+    return jnp.einsum("p,pij->ij", jnp.asarray(B_f, _fdtype()), blocks)
