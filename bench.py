@@ -48,6 +48,13 @@ import numpy as np
 #        (re-measured same code: 0.888; round-3 0.663, round-1 0.407)
 _CPU_BASELINE_PINNED = {60: 0.0633, 5: 0.888}
 
+# Our own solver at the north-star shape on this host's CPU, measured
+# SOLO (f64 via the pinned-baseline protocol above; f32 same program):
+# recorded so the north-star-shape comparison vs the measured reference
+# C rides in the bench artifact even when the TPU tunnel forces the
+# small-shape fallback.
+_OURS_CPU_NORTH_STAR = {"f64": 0.0633, "f32": 0.1258}
+
 # The ACTUAL reference C solver timed at the north-star shape:
 # bfgsfit_visibilities (lmfit.c:1126, robust R-LBFGS mode 2) on the
 # channel-averaged tile, compiled from the mounted reference sources and
@@ -61,6 +68,13 @@ _CPU_BASELINE_PINNED = {60: 0.0633, 5: 0.888}
 # REF_BENCH_TILESZ=5 -> 20 iters in 82.9 s = 0.2411 it/s.
 _REF_CPU_PINNED = {60: 0.013, 5: 0.2411}
 _REF_CPU_THREADS = 1  # this container exposes a single core
+
+# Cost-evaluation-equivalents the REFERENCE burns per LBFGS iteration:
+# one hand-coded gradient (~1 cost-equivalent of threaded C,
+# robust_lbfgs.c:155) plus the Fletcher/cubic line search's typical
+# ~0.5 extra cost calls once bracketed (lbfgs.c:116-443).  Used for the
+# equal-work ratio below; ours is ~3 (see cost_evals in main()).
+_REF_COST_EVALS_PER_ITER = 1.5
 
 NSTATIONS = 62
 NCLUSTERS = 100
@@ -372,6 +386,23 @@ def main():
     ref_c = _REF_CPU_PINNED.get(tilesz)
     vs_ref = value / ref_c if ref_c else None
 
+    # Equal-work ratio (the honesty prose of ref_bench.py moved into
+    # the artifact): an LBFGS iteration is the unit of convergence
+    # progress in both codes, but ours is the costlier iteration —
+    # ~3 cost-equivalents per iteration (fused value_and_grad loop;
+    # cost_evals below) vs the reference's ~1.5
+    # (_REF_COST_EVALS_PER_ITER).  Charge us for the extra
+    # evaluations and do NOT credit that each of our evaluations
+    # covers NCHAN=2 channel models vs the reference's single
+    # channel-averaged model (lmfit.c:1140-1158) — i.e. this is the
+    # CONSERVATIVE ratio; the uncredited channel factor (2x in our
+    # favor) is recorded alongside.
+    our_evals_per_iter = 3.0 + 2.0 / max(LBFGS_ITERS, 1)
+    vs_ref_equal = (
+        vs_ref * _REF_COST_EVALS_PER_ITER / our_evals_per_iter
+        if vs_ref else None
+    )
+
     # throughput roofline from ANALYTIC counts (see
     # analytic_flops_per_cost_eval).  Cost-equivalents per LBFGS
     # iteration after the fused value_and_grad restructure (the loop
@@ -399,8 +430,22 @@ def main():
         "cpu_baseline_iters_per_sec": base,
         "cpu_baseline_source": "measured-live" if cpu_measured else "pinned",
         "vs_reference_cpu": round(vs_ref, 3) if vs_ref else None,
+        "vs_reference_cpu_equal_work": (
+            round(vs_ref_equal, 3) if vs_ref_equal else None
+        ),
+        "equal_work_model": (
+            f"ratio x {_REF_COST_EVALS_PER_ITER}/"
+            f"{round(our_evals_per_iter, 2)} cost-evals per iter; "
+            f"our {NCHAN}-channels-per-eval vs reference's 1 "
+            "channel-averaged model NOT credited (2x in our favor)"
+        ) if vs_ref_equal else None,
         "ref_cpu_iters_per_sec": ref_c,
         "ref_cpu_threads": _REF_CPU_THREADS if ref_c else None,
+        "ref_threads_caveat": (
+            "reference pinned single-core on this 1-core host; its hot "
+            "loops are pthread-parallel, so vs_reference_cpu is "
+            "per-chip vs per-core, scaling ~1/k on a k-core host"
+        ) if ref_c else None,
         "north_star_shape": tilesz == TILESZ,
         "analytic_tflops_per_sec": round(flops_per_sec / 1e12, 4),
         "analytic_hbm_gb_per_sec": round(gbytes_per_sec, 1),
@@ -409,6 +454,20 @@ def main():
     }
     if xla_flops:
         rec["xla_cost_analysis_tflops_per_sec"] = round(xla_flops / dt / 1e12, 4)
+    # North-star-shape same-core evidence, in the artifact rather than
+    # round-notes prose: both sides measured solo on this host's single
+    # core (ref_bench.py / _measure_cpu_subprocess, 2026-07-30).
+    ref_ns = _REF_CPU_PINNED[TILESZ]
+    rec["north_star_cpu_pinned"] = {
+        "ours_f64_iters_per_sec": _OURS_CPU_NORTH_STAR["f64"],
+        "ours_f32_iters_per_sec": _OURS_CPU_NORTH_STAR["f32"],
+        "ref_c_iters_per_sec": ref_ns,
+        "vs_ref_same_core_f64": round(_OURS_CPU_NORTH_STAR["f64"] / ref_ns, 3),
+        "vs_ref_same_core_f64_equal_work": round(
+            _OURS_CPU_NORTH_STAR["f64"] / ref_ns
+            * _REF_COST_EVALS_PER_ITER / our_evals_per_iter, 3
+        ),
+    }
     print(json.dumps(rec))
 
 
