@@ -49,11 +49,11 @@ import numpy as np
 _CPU_BASELINE_PINNED = {60: 0.0633, 5: 0.888}
 
 # Our own solver at the north-star shape on this host's CPU, measured
-# SOLO (f64 via the pinned-baseline protocol above; f32 same program):
-# recorded so the north-star-shape comparison vs the measured reference
-# C rides in the bench artifact even when the TPU tunnel forces the
-# small-shape fallback.
-_OURS_CPU_NORTH_STAR = {"f64": 0.0633, "f32": 0.1258}
+# SOLO (f64 is the same measurement as the pinned baseline above; f32
+# same program): recorded so the north-star-shape comparison vs the
+# measured reference C rides in the bench artifact even when the TPU
+# tunnel forces the small-shape fallback.
+_OURS_CPU_NORTH_STAR = {"f64": _CPU_BASELINE_PINNED[60], "f32": 0.1258}
 
 # The ACTUAL reference C solver timed at the north-star shape:
 # bfgsfit_visibilities (lmfit.c:1126, robust R-LBFGS mode 2) on the

@@ -73,9 +73,24 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-a", "--simulate", type=int, default=0,
                     help="1: model only, 2: add, 3: subtract")
     ap.add_argument("-z", "--ignore-clusters", default=None)
-    ap.add_argument("-E", "--ccid", type=int, default=None,
-                    help="cluster id whose inverse corrects the residual")
-    ap.add_argument("--phase-only-correction", action="store_true")
+    ap.add_argument("-k", "--ccid", type=int, default=None,
+                    help="cluster id whose inverse corrects the residual "
+                    "(ref -k)")
+    ap.add_argument("-E", "--gpu-predict", type=int, default=0,
+                    help="accepted for drop-in compatibility (ref -E GPU "
+                    "predict toggle); the whole compute path is the "
+                    "accelerator here")
+    ap.add_argument("-o", "--correction-rho", type=float, default=1e-9,
+                    help="robust rho added to the MMSE matrix inversion "
+                    "when correcting residuals by a cluster's solution "
+                    "(ref -o, main.cpp:80)")
+    ap.add_argument("-J", "--phase-only", type=int, default=0,
+                    help="if >0, phase-only correction (ref -J)")
+    ap.add_argument("--phase-only-correction", action="store_true",
+                    help="alias for -J 1")
+    ap.add_argument("-n", "--threads", type=int, default=0,
+                    help="accepted for drop-in compatibility (ref -n "
+                    "worker threads); parallelism is managed by XLA")
     ap.add_argument("-N", "--epochs", type=int, default=0)
     ap.add_argument("-M", "--minibatches", type=int, default=1)
     ap.add_argument("-w", "--bands", type=int, default=1)
@@ -83,6 +98,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-P", "--npoly", type=int, default=2)
     ap.add_argument("-Q", "--poly-type", type=int, default=2)
     ap.add_argument("-r", "--admm-rho", type=float, default=5.0)
+    ap.add_argument("-C", "--adaptive-rho", type=int, default=0,
+                    help="if >0, adaptive (Barzilai-Borwein) update of "
+                    "the ADMM regularization (ref -C aadmm, default off "
+                    "as in the reference)")
     ap.add_argument("--fused", action="store_true",
                     help="route the joint-LBFGS cost through the fused "
                          "Pallas RIME kernel (f32 runs only)")
@@ -133,7 +152,7 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--mdl", action="store_true",
                     help="score consensus polynomial orders by AIC/MDL "
                     "each tile (ref master -M, mdl.c)")
-    ap.add_argument("--federated-alpha", type=float, default=5.0,
+    ap.add_argument("-u", "--federated-alpha", type=float, default=5.0,
                     help="federated Z~Zavg coupling strength for the "
                     "-f + -N stochastic mode (ref alpha, "
                     "find_prod_inverse_full_fed)")
@@ -171,7 +190,9 @@ def config_from_args(args) -> RunConfig:
         simulation_mode=args.simulate,
         ignore_clusters_file=args.ignore_clusters,
         ccid=args.ccid,
-        phase_only_correction=args.phase_only_correction,
+        correction_rho=args.correction_rho,
+        phase_only_correction=(args.phase_only_correction
+                               or args.phase_only > 0),
         epochs=args.epochs,
         minibatches=args.minibatches,
         in_column=args.in_column,
@@ -254,6 +275,7 @@ def main(argv=None):
             spatial_lam=sp_lam,
             mdl=args.mdl,
             global_residual=bool(args.global_residual),
+            adaptive_rho=args.adaptive_rho > 0,
         )
     elif cfg.epochs > 0:
         from sagecal_tpu.apps.minibatch import run_minibatch

@@ -112,6 +112,7 @@ def run_distributed(
     spatial_fista_maxiter: int = 30,
     mdl: bool = False,
     global_residual: bool = False,
+    adaptive_rho: bool = True,
 ):
     """Calibrate a multi-band observation on the device mesh.
 
@@ -155,7 +156,7 @@ def run_distributed(
             spatial_n0, spatial_beta, spatial_mu, spatial_alpha,
             spatial_cadence, spatial_basis, spatial_diffuse_id,
             spatial_gamma, spatial_lam, mdl, spatial_fista_maxiter,
-            global_residual,
+            global_residual, adaptive_rho,
         )
     finally:
         for fh in open_files:
@@ -175,7 +176,7 @@ def _run_distributed_inner(
     spatial_n0, spatial_beta, spatial_mu, spatial_alpha, spatial_cadence,
     spatial_basis="shapelet", spatial_diffuse_id=None, spatial_gamma=0.0,
     spatial_lam=0.0, mdl=False, spatial_fista_maxiter=30,
-    global_residual=False,
+    global_residual=False, adaptive_rho=True,
 ):
     metas = [h.meta for h in handles]
     ntime = _check_band_consistency(metas, log)
@@ -279,7 +280,7 @@ def _run_distributed_inner(
         mesh, nadmm=nadmm, max_emiter=cfg.max_emiter,
         plain_emiter=max(cfg.max_emiter, 2),
         lm_config=LMConfig(itmax=cfg.max_iter),
-        bb_rho=True, solver_mode=cfg.solver_mode,
+        bb_rho=adaptive_rho, solver_mode=cfg.solver_mode,
         spatial=spatial,
     )
 

@@ -333,6 +333,19 @@ class TestCLI:
         assert cfg.tilesz == 10 and cfg.solver_mode == 5
         assert cfg.epochs == 2 and cfg.bands == 3 and cfg.admm_iters == 5
         assert cfg.cluster_file == "sky.txt.cluster"
+        assert cfg.correction_rho == 1e-9  # ref -o default (data.cpp:73)
+
+    def test_parser_correction_rho(self):
+        args = build_parser().parse_args(
+            ["-d", "x.h5", "-s", "sky.txt", "-k", "3", "-o", "1e-5"]
+        )
+        cfg = config_from_args(args)
+        assert cfg.ccid == 3 and cfg.correction_rho == 1e-5
+        # ref drop-in: -E is the reference's GPU toggle, NOT ccid
+        args2 = build_parser().parse_args(
+            ["-d", "x.h5", "-s", "sky.txt", "-E", "1"]
+        )
+        assert config_from_args(args2).ccid is None
 
     def test_cli_fullbatch_run(self, workdir):
         dsp = workdir / "d.h5"
