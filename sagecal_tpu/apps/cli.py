@@ -219,9 +219,12 @@ def _warn_dropped_fused(args, log=print):
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "convert":
+        # convert <ms> <h5> [spw] — multi-SPW MSs convert one window
+        # per .h5 band file (the reference expects pre-split MSs)
         from sagecal_tpu.io.dataset import ms_to_h5
 
-        ms_to_h5(argv[1], argv[2])
+        ms_to_h5(argv[1], argv[2],
+                 spw=int(argv[3]) if len(argv) > 3 else 0)
         return 0
     args = build_parser().parse_args(argv)
     _warn_dropped_fused(args)

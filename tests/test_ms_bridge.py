@@ -16,22 +16,55 @@ NBASE = NSTA * (NSTA - 1) // 2
 
 
 class FakeTable:
-    """Minimal casacore.tables.table over an in-memory column dict."""
+    """Minimal casacore.tables.table over an in-memory column dict.
+
+    Columns stored as a LIST of per-row arrays are variable-shaped:
+    ``getcol`` np.stack's them and therefore raises on mismatched row
+    shapes, mimicking real casacore's array-conformance error on
+    heterogeneous (multi-SPW) columns.  ``selectrows`` returns a
+    write-through reference view, as in casacore."""
 
     store: dict = {}
 
     def __init__(self, path, readonly=True):
         self.path = path
         self.cols = self.store[path]
+        self.rownrs = None  # None = whole table
+
+    def selectrows(self, rownrs):
+        v = object.__new__(FakeTable)
+        v.path = self.path
+        v.cols = self.cols
+        v.rownrs = np.asarray(rownrs)
+        return v
 
     def nrows(self):
-        return len(next(iter(self.cols.values())))
+        c = next(iter(self.cols.values()))
+        return len(c) if self.rownrs is None else len(self.rownrs)
 
     def getcol(self, name):
-        return np.asarray(self.cols[name])
+        c = self.cols[name]
+        if isinstance(c, list):
+            rows = c if self.rownrs is None else [c[i] for i in self.rownrs]
+            return np.stack(rows)  # raises on mismatched shapes
+        a = np.asarray(c)
+        return a if self.rownrs is None else a[self.rownrs]
+
+    def getcell(self, name, row):
+        return np.asarray(self.cols[name][row])
 
     def putcol(self, name, vals):
-        self.cols[name] = np.asarray(vals)
+        c = self.cols.get(name)
+        if isinstance(c, list):
+            idx = (range(len(c)) if self.rownrs is None else self.rownrs)
+            for j, i in enumerate(idx):
+                c[i] = np.asarray(vals[j])
+        elif self.rownrs is None:
+            self.cols[name] = np.asarray(vals)
+        else:
+            a = np.asarray(c).copy()
+            a[self.rownrs] = vals
+            self.cols[name] = a
 
     def colnames(self):
         return list(self.cols.keys())
@@ -42,7 +75,11 @@ class FakeTable:
     def addcols(self, desc):
         # makecoldesc returns (name, desc); create zero-filled like DATA
         name, _ = desc
-        self.cols[name] = np.zeros_like(np.asarray(self.cols["DATA"]))
+        d = self.cols["DATA"]
+        if isinstance(d, list):
+            self.cols[name] = [np.zeros_like(np.asarray(r)) for r in d]
+        else:
+            self.cols[name] = np.zeros_like(np.asarray(d))
 
     def close(self):
         pass
@@ -152,6 +189,169 @@ def test_ms_to_h5_roundtrip(tmp_path, monkeypatch):
     # seeds from DATA (CASA convention), so they keep the DATA values
     auto_idx = np.flatnonzero(~cross)
     np.testing.assert_allclose(out[auto_idx], ms["DATA"][auto_idx])
+
+
+def _fake_multispw_ms(rng):
+    """Two spectral windows behind DATA_DESC_ID (with the
+    DATA_DESCRIPTION indirection), rows interleaved, plus
+    WEIGHT_SPECTRUM — the real-casacore semantics VERDICT r4 flagged as
+    unexercised (data.cpp reads CHAN_FREQ row 0 and assumes pre-split
+    MSs; our bridge selects a window)."""
+    nchan_of = {0: 2, 1: 3}  # HETEROGENEOUS windows: full-table getcol
+    # on DATA/FLAG must raise, as in real casacore
+    rows = []
+    for spw in (0, 1):
+        for ti in range(NTIME):
+            t = 5e9 + 10.0 * ti
+            for a in range(NSTA):
+                rows.append((t, a, a, spw))
+            for a in range(NSTA):
+                for b in range(a + 1, NSTA):
+                    rows.append((t, a, b, spw))
+    rows = np.asarray(rows)
+    rows = rows[rng.permutation(len(rows))]
+    data, flag, ws = [], [], []
+    for r in rows:
+        nc = nchan_of[int(r[3])]
+        data.append(rng.standard_normal((nc, 4))
+                    + 1j * rng.standard_normal((nc, 4)))
+        flag.append(rng.random((nc, 4)) < 0.1)
+        ws.append(rng.random((nc, 4)) + 0.5)
+    ms = {
+        "TIME": rows[:, 0],
+        "ANTENNA1": rows[:, 1].astype(np.int32),
+        "ANTENNA2": rows[:, 2].astype(np.int32),
+        # DATA_DESC ids 5 and 9 map to SPW rows 0 and 1 — the ids are
+        # NOT the window indices, so a bridge that skips the
+        # DATA_DESCRIPTION indirection fails this fixture
+        "DATA_DESC_ID": np.where(rows[:, 3] == 0, 5, 9).astype(np.int32),
+        "DATA": data,
+        "FLAG": flag,
+        "UVW": rng.standard_normal((len(rows), 3)) * 100.0,
+        "WEIGHT_SPECTRUM": ws,
+    }
+    dd_ids = np.full(10, -1, np.int32)
+    dd_ids[5], dd_ids[9] = 0, 1
+    store = {
+        "multi.ms": ms,
+        "multi.ms/ANTENNA": {"NAME": np.asarray([f"S{i}" for i in range(NSTA)])},
+        "multi.ms/SPECTRAL_WINDOW": {
+            # SPW 1 is a lower-sideband window: negative CHAN_WIDTH
+            "CHAN_FREQ": [np.asarray([140e6, 150e6]),
+                          np.asarray([180e6, 170e6, 160e6])],
+            "CHAN_WIDTH": [np.asarray([180e3, 180e3]),
+                           np.asarray([-90e3, -90e3, -90e3])],
+        },
+        "multi.ms/DATA_DESCRIPTION": {"SPECTRAL_WINDOW_ID": dd_ids},
+        "multi.ms/FIELD": {"PHASE_DIR": np.asarray([[[0.1, 0.4]]])},
+    }
+    return store
+
+
+def test_multispw_selection_and_weights(tmp_path, monkeypatch):
+    from sagecal_tpu.io import dataset as dsm
+
+    rng = np.random.default_rng(11)
+    store = _fake_multispw_ms(rng)
+    _fake_casacore(monkeypatch, store)
+    ms = store["multi.ms"]
+    spw_of_row = np.where(ms["DATA_DESC_ID"] == 5, 0, 1)
+
+    # the fixture is genuinely heterogeneous: a full-table getcol on
+    # DATA raises, as real casacore would
+    from casacore.tables import table as fake_table
+    with pytest.raises(ValueError):
+        fake_table("multi.ms").getcol("DATA")
+
+    for spw, f0, nchan, df in ((0, 140e6, 2, 2 * 180e3),
+                               (1, 180e6, 3, 3 * 90e3)):
+        h5 = str(tmp_path / f"spw{spw}.h5")
+        dsm.ms_to_h5("multi.ms", h5, spw=spw)
+        sel = (ms["ANTENNA1"] != ms["ANTENNA2"]) & (spw_of_row == spw)
+        order = np.lexsort((ms["ANTENNA2"][sel], ms["ANTENNA1"][sel],
+                            ms["TIME"][sel]))
+        dsel = np.stack([ms["DATA"][i] for i in np.flatnonzero(sel)])
+        wsel = np.stack([ms["WEIGHT_SPECTRUM"][i]
+                         for i in np.flatnonzero(sel)])
+        want = dsel[order].reshape(NTIME, NBASE, nchan, 2, 2)
+        want_w = wsel.mean(-1)[order].reshape(NTIME, NBASE, nchan)
+        with h5py.File(h5, "r") as f:
+            np.testing.assert_allclose(np.asarray(f["vis"]), want)
+            np.testing.assert_allclose(np.asarray(f["freqs"])[0], f0)
+            np.testing.assert_allclose(np.asarray(f["weight"]), want_w)
+            # deltaf from CHAN_WIDTH, abs()'d (SPW 1 is lower-sideband)
+            np.testing.assert_allclose(f.attrs["deltaf"], df)
+
+    # write-back touches ONLY the selected window's cross rows; the
+    # freshly created column seeds every other row from DATA
+    h5 = str(tmp_path / "spw0.h5")
+    corrected = (rng.standard_normal((NTIME, NBASE, 2, 2, 2))
+                 + 1j * rng.standard_normal((NTIME, NBASE, 2, 2, 2)))
+    with h5py.File(h5, "r+") as f:
+        f.create_dataset("corrected", data=corrected)
+    dsm.h5_to_ms(h5, "multi.ms", column="corrected", spw=0)
+    out = store["multi.ms"]["CORRECTED_DATA"]
+    sel0 = (ms["ANTENNA1"] != ms["ANTENNA2"]) & (spw_of_row == 0)
+    order0 = np.lexsort((ms["ANTENNA2"][sel0], ms["ANTENNA1"][sel0],
+                         ms["TIME"][sel0]))
+    got = np.stack([out[i] for i in np.flatnonzero(sel0)])[order0]
+    np.testing.assert_allclose(
+        got, corrected.reshape(NTIME * NBASE, 2, 4))
+    for i in np.flatnonzero(~sel0):
+        np.testing.assert_allclose(out[i], ms["DATA"][i])
+
+    # out-of-range window and missing column fail loudly
+    with pytest.raises(ValueError, match="out of range"):
+        dsm.ms_to_h5("multi.ms", str(tmp_path / "x.h5"), spw=2)
+    with pytest.raises(KeyError, match="MODEL_DATA"):
+        dsm.ms_to_h5("multi.ms", str(tmp_path / "x.h5"),
+                     data_column="MODEL_DATA")
+
+
+def test_weight_fallback_and_dual_pol(tmp_path, monkeypatch):
+    """WEIGHT (per-row) broadcasts over channels when WEIGHT_SPECTRUM is
+    absent; 2-correlation data lands on the Jones diagonal with zero
+    cross-hands (the reference's n_corr==2 path, data.cpp:684-695)."""
+    from sagecal_tpu.io import dataset as dsm
+
+    rng = np.random.default_rng(12)
+    store = _fake_ms(rng)
+    ms = store["fake.ms"]
+    nr = len(ms["TIME"])
+    ms["DATA"] = ms["DATA"][..., [0, 3]]  # dual-pol XX, YY
+    ms["FLAG"] = ms["FLAG"][..., [0, 3]]
+    ms["WEIGHT"] = rng.random((nr, 2)) + 0.25
+    _fake_casacore(monkeypatch, store)
+
+    h5 = str(tmp_path / "dual.h5")
+    dsm.ms_to_h5("fake.ms", h5)
+    cross = ms["ANTENNA1"] != ms["ANTENNA2"]
+    order = np.lexsort((ms["ANTENNA2"][cross], ms["ANTENNA1"][cross],
+                        ms["TIME"][cross]))
+    want = ms["DATA"][cross][order].reshape(NTIME, NBASE, NCHAN, 2)
+    with h5py.File(h5, "r") as f:
+        vis = np.asarray(f["vis"])
+        np.testing.assert_allclose(vis[..., 0, 0], want[..., 0])
+        np.testing.assert_allclose(vis[..., 1, 1], want[..., 1])
+        np.testing.assert_allclose(vis[..., 0, 1], 0)
+        np.testing.assert_allclose(vis[..., 1, 0], 0)
+        w = np.asarray(f["weight"])
+        want_w = ms["WEIGHT"][cross].mean(-1)[order].reshape(NTIME, NBASE)
+        np.testing.assert_allclose(w, np.repeat(
+            want_w[..., None], NCHAN, axis=-1))
+
+
+def test_flag_column_optional(tmp_path, monkeypatch):
+    from sagecal_tpu.io import dataset as dsm
+
+    rng = np.random.default_rng(13)
+    store = _fake_ms(rng)
+    del store["fake.ms"]["FLAG"]
+    _fake_casacore(monkeypatch, store)
+    h5 = str(tmp_path / "noflag.h5")
+    dsm.ms_to_h5("fake.ms", h5)
+    with h5py.File(h5, "r") as f:
+        assert not np.asarray(f["flag"]).any()
 
 
 def test_h5_to_ms_row_mismatch_raises(tmp_path, monkeypatch):
