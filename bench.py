@@ -39,21 +39,22 @@ import numpy as np
 #   python -c "import bench; print(bench._measure_cpu_subprocess(60))"
 # pinned per workload shape (tilesz -> iters/sec, f64 CPU):
 #   60 = the north-star shape (BASELINE.md graded config 1, -t 60);
-#        re-measured SOLO with the round-4 value_and_grad LBFGS
-#        restructure AND the coh-dtype fix keeping f64 genuinely f64:
-#        0.0633 it/s (history: round-2 layout 0.0142, rows-minor
-#        0.0212, round-3 factored predict 0.0555 — every TPU-first
-#        restructuring also sped up the CPU)
+#        re-measured SOLO with the round-5 trial-point value_and_grad
+#        fusion: 0.0782 it/s (history: round-2 layout 0.0142,
+#        rows-minor 0.0212, round-3 factored predict 0.0555, round-4
+#        fused value_and_grad 0.0633 — every TPU-first restructuring
+#        also sped up the CPU)
 #    5 = the small shape used when falling back to the CPU platform
-#        (re-measured same code: 0.888; round-3 0.663, round-1 0.407)
-_CPU_BASELINE_PINNED = {60: 0.0633, 5: 0.888}
+#        (re-measured same code: 1.0872; round-4 0.888, round-3 0.663,
+#        round-1 0.407)
+_CPU_BASELINE_PINNED = {60: 0.0782, 5: 1.0872}
 
 # Our own solver at the north-star shape on this host's CPU, measured
 # SOLO (f64 is the same measurement as the pinned baseline above; f32
 # same program): recorded so the north-star-shape comparison vs the
 # measured reference C rides in the bench artifact even when the TPU
 # tunnel forces the small-shape fallback.
-_OURS_CPU_NORTH_STAR = {"f64": _CPU_BASELINE_PINNED[60], "f32": 0.1258}
+_OURS_CPU_NORTH_STAR = {"f64": _CPU_BASELINE_PINNED[60], "f32": 0.1441}
 
 # The ACTUAL reference C solver timed at the north-star shape:
 # bfgsfit_visibilities (lmfit.c:1126, robust R-LBFGS mode 2) on the
@@ -389,15 +390,15 @@ def main():
     # Equal-work ratio (the honesty prose of ref_bench.py moved into
     # the artifact): an LBFGS iteration is the unit of convergence
     # progress in both codes, but ours is the costlier iteration —
-    # ~3 cost-equivalents per iteration (fused value_and_grad loop;
-    # cost_evals below) vs the reference's ~1.5
+    # ~2 cost-equivalents per iteration (fused trial-point
+    # value_and_grad; cost_evals below) vs the reference's ~1.5
     # (_REF_COST_EVALS_PER_ITER).  Charge us for the extra
     # evaluations and do NOT credit that each of our evaluations
     # covers NCHAN=2 channel models vs the reference's single
     # channel-averaged model (lmfit.c:1140-1158) — i.e. this is the
     # CONSERVATIVE ratio; the uncredited channel factor (2x in our
     # favor) is recorded alongside.
-    our_evals_per_iter = 3.0 + 2.0 / max(LBFGS_ITERS, 1)
+    our_evals_per_iter = 2.0 + 2.0 / max(LBFGS_ITERS, 1)
     vs_ref_equal = (
         vs_ref * _REF_COST_EVALS_PER_ITER / our_evals_per_iter
         if vs_ref else None
@@ -405,13 +406,13 @@ def main():
 
     # throughput roofline from ANALYTIC counts (see
     # analytic_flops_per_cost_eval).  Cost-equivalents per LBFGS
-    # iteration after the fused value_and_grad restructure (the loop
-    # carries f, Armijo reuses it): first trial point (1x) + one
-    # value_and_grad pass (~2x a cost eval) = 3x; +2 per fit for the
-    # initial value_and_grad (the final cost is carried, not
-    # re-evaluated).  Lower bound: extra line-search halvings are not
-    # counted.
-    cost_evals = 3 * iters + 2
+    # iteration after the round-5 trial-point fusion (value_and_grad
+    # evaluated AT the first Armijo trial, accepted in the common
+    # case): one fused (f, g) pass (~2x a cost eval) per iteration;
+    # +2 per fit for the initial value_and_grad (the final cost is
+    # carried, not re-evaluated).  Lower bound: line-search rejections
+    # (extra cost-only halvings + one extra (f, g)) are not counted.
+    cost_evals = 2 * iters + 2
     fl_eval = analytic_flops_per_cost_eval(tilesz)
     by_eval = hbm_bytes_per_cost_eval(
         tilesz, coh_bytes_per_cplx=4 if COH_BF16 and not FUSED else 8
