@@ -1,0 +1,9 @@
+#!/bin/bash
+# Restarts tpu_recover.sh if it hits its 11h give-up deadline while the
+# tunnel is still wedged (round 5 runs past the round-4 watcher's
+# deadline).  Exits quietly if the watcher ended because it banked.
+while ps -p "$1" >/dev/null 2>&1; do sleep 120; done
+if tail -3 /root/repo/tpu_watch.log | grep -q "GAVE UP"; then
+  echo "supervisor: restarting watcher at $(date)" >> /root/repo/tpu_watch.log
+  exec /root/repo/tpu_recover.sh
+fi
