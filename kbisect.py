@@ -155,20 +155,6 @@ def variant_e():
     return f, (tre, tim, coh, antp, antq)
 
 
-VARIANTS = {"a": variant_a, "b": variant_b, "c": variant_c,
-            "d": variant_d, "e": variant_e}
-
-if __name__ == "__main__":
-    for name in sys.argv[1:]:
-        print(f"[{name}] building...", flush=True)
-        f, args = VARIANTS[name]()
-        dev = jax.devices()[0]
-        args = tuple(jax.device_put(a, dev) for a in args)
-        t0 = time.time()
-        v = float(np.asarray(f(*args)))
-        print(f"[{name}] ok: {time.time()-t0:.1f}s val={v:.5g}", flush=True)
-
-
 def variant_f():
     """Reshape-free gains: component-major tables, one dot per comp."""
     def k(antp_ref, tab_ref, out_ref):
@@ -199,4 +185,15 @@ def variant_f():
     return f, (antp, tab)
 
 
-VARIANTS["f"] = variant_f
+VARIANTS = {"a": variant_a, "b": variant_b, "c": variant_c,
+            "d": variant_d, "e": variant_e, "f": variant_f}
+
+if __name__ == "__main__":
+    for name in sys.argv[1:]:
+        print(f"[{name}] building...", flush=True)
+        f, args = VARIANTS[name]()
+        dev = jax.devices()[0]
+        args = tuple(jax.device_put(a, dev) for a in args)
+        t0 = time.time()
+        v = float(np.asarray(f(*args)))
+        print(f"[{name}] ok: {time.time()-t0:.1f}s val={v:.5g}", flush=True)
