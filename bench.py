@@ -355,6 +355,14 @@ def _measure_cpu_subprocess(tilesz=TILESZ, timeout=1800.0):
 def main():
     import jax
 
+    # persistent compile cache: a prior successful TPU compile (e.g. the
+    # recovery watcher's banked run) makes later runs start in seconds
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     if not _probe_default_backend():
         sys.stderr.write(
             "bench: default (axon TPU) backend unavailable or wedged; "
