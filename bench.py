@@ -74,8 +74,15 @@ _REF_CPU_THREADS = 1  # this container exposes a single core
 # one hand-coded gradient (~1 cost-equivalent of threaded C,
 # robust_lbfgs.c:155) plus the Fletcher/cubic line search's typical
 # ~0.5 extra cost calls once bracketed (lbfgs.c:116-443).  Used for the
-# equal-work ratio below; ours is ~3 (see cost_evals in main()).
+# equal-work ratio below.
 _REF_COST_EVALS_PER_ITER = 1.5
+
+# Ours, MEASURED (2026-07-31, instrumented 20-iteration run of this
+# bench workload): 18/20 iterations accept the first Armijo trial (one
+# fused value_and_grad = ~2 cost-equivalents); the 2 early rejections
+# add 10 cost-only halvings + 2 extra (f, g) passes -> 2.70 effective
+# cost-equivalents per iteration.  The ideal-accept floor is 2.1.
+_OUR_COST_EVALS_PER_ITER_MEASURED = 2.7
 
 NSTATIONS = 62
 NCLUSTERS = 100
@@ -398,15 +405,15 @@ def main():
     # Equal-work ratio (the honesty prose of ref_bench.py moved into
     # the artifact): an LBFGS iteration is the unit of convergence
     # progress in both codes, but ours is the costlier iteration —
-    # ~2 cost-equivalents per iteration (fused trial-point
-    # value_and_grad; cost_evals below) vs the reference's ~1.5
-    # (_REF_COST_EVALS_PER_ITER).  Charge us for the extra
-    # evaluations and do NOT credit that each of our evaluations
-    # covers NCHAN=2 channel models vs the reference's single
-    # channel-averaged model (lmfit.c:1140-1158) — i.e. this is the
-    # CONSERVATIVE ratio; the uncredited channel factor (2x in our
-    # favor) is recorded alongside.
-    our_evals_per_iter = 2.0 + 2.0 / max(LBFGS_ITERS, 1)
+    # the MEASURED 2.7 cost-equivalents per iteration
+    # (_OUR_COST_EVALS_PER_ITER_MEASURED, incl. line-search
+    # rejections) vs the reference's ~1.5 (_REF_COST_EVALS_PER_ITER).
+    # Charge us for the extra evaluations and do NOT credit that each
+    # of our evaluations covers NCHAN=2 channel models vs the
+    # reference's single channel-averaged model (lmfit.c:1140-1158) —
+    # i.e. this is the CONSERVATIVE ratio; the uncredited channel
+    # factor (2x in our favor) is recorded alongside.
+    our_evals_per_iter = _OUR_COST_EVALS_PER_ITER_MEASURED
     vs_ref_equal = (
         vs_ref * _REF_COST_EVALS_PER_ITER / our_evals_per_iter
         if vs_ref else None
