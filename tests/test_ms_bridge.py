@@ -340,6 +340,21 @@ def test_weight_fallback_and_dual_pol(tmp_path, monkeypatch):
         np.testing.assert_allclose(w, np.repeat(
             want_w[..., None], NCHAN, axis=-1))
 
+    # write-back into the dual-pol MS maps the Jones diagonal onto the
+    # 2-correlation column (sel [0, 3]) and drops the cross-hands
+    rng2 = np.random.default_rng(21)
+    corrected = (rng2.standard_normal((NTIME, NBASE, NCHAN, 2, 2))
+                 + 1j * rng2.standard_normal((NTIME, NBASE, NCHAN, 2, 2)))
+    with h5py.File(h5, "r+") as f:
+        f.create_dataset("corrected", data=corrected)
+    dsm.h5_to_ms(h5, "fake.ms", column="corrected",
+                 ms_column="CORRECTED_DATA")
+    out = store["fake.ms"]["CORRECTED_DATA"]
+    got = out[np.flatnonzero(cross)][order].reshape(NTIME, NBASE, NCHAN, 2)
+    flat = corrected.reshape(NTIME, NBASE, NCHAN, 4)
+    np.testing.assert_allclose(got[..., 0], flat[..., 0])  # XX
+    np.testing.assert_allclose(got[..., 1], flat[..., 3])  # YY
+
 
 def test_flag_column_optional(tmp_path, monkeypatch):
     from sagecal_tpu.io import dataset as dsm
