@@ -93,9 +93,14 @@ REPEATS = 3
 
 V5E_BF16_PEAK_FLOPS = 197e12  # TPU v5e per-chip peak (bf16)
 
-# Cost path selector, read ONCE so run() and the JSON record can't
-# diverge: 1 = fused Pallas RIME kernel, 0 = XLA predict path.
-FUSED = bool(int(os.environ.get("SAGECAL_BENCH_FUSED", "0")))
+# Cost path selector, resolved ONCE so run() and the JSON record can't
+# diverge: 1 = fused Pallas RIME kernel, 0 = XLA predict path.  Default
+# (env unset): fused on the TPU — hardware-validated round 5 at 40.6
+# it/s vs 14.8 for the XLA path — and XLA on the CPU fallback, where
+# interpret-mode Pallas would be orders slower.  main() resolves the
+# platform-dependent default before run() reads this global.
+_FUSED_ENV = os.environ.get("SAGECAL_BENCH_FUSED")
+FUSED = bool(int(_FUSED_ENV)) if _FUSED_ENV is not None else False
 
 # Store the (static) coherency stack as bfloat16, upcast to f32 inside
 # the jitted cost: halves the dominant HBM stream of the bandwidth-
@@ -183,26 +188,35 @@ def make_step(data, cdata, nu=5.0):
     return step
 
 
-def make_fused_step(data, nu=5.0, tile=512):
+def make_fused_step(data, nu=5.0, tile=None):
     """LBFGS step whose cost uses the fused Pallas RIME kernel
     (ops/rime_kernel.py) instead of the XLA predict path.  Returns
     (prep, step): ``prep`` pads rows/clusters to kernel alignment ONCE
     (run it before the timing loop, keep results device-resident);
-    ``step`` takes the padded arrays.  Opt-in via SAGECAL_BENCH_FUSED=1
-    until validated on the chip."""
+    ``step`` takes the padded arrays.  Default on TPU since the round-5
+    hardware validation (SAGECAL_BENCH_FUSED=0 opts back to XLA).
+
+    tile defaults to FULL_CLUSTER_TILE (128, the largest tile whose
+    BACKWARD kernel fits the v5e 16 MB scoped-VMEM limit at Mp=104 —
+    hardware-verified round 5); rows are chunked into
+    rime_kernel.MAX_GRID_ROWS blocks so each Mosaic grid stays short
+    (north star: 4 chunks x 28416 rows = R=222 grids at tile 128,
+    the configuration of the banked 40.6 it/s)."""
     import jax
     import jax.numpy as jnp
 
     from sagecal_tpu.core.types import params_to_jones
     from sagecal_tpu.ops.rime_kernel import (
-        fused_predict_packed, pack_gain_tables, pad_to,
+        FULL_CLUSTER_TILE, chunked_rowsp, fused_predict_packed_chunked,
+        pack_gain_tables, pad_to,
     )
     from sagecal_tpu.solvers.lbfgs import lbfgs_fit
 
+    tile = FULL_CLUSTER_TILE if tile is None else tile
     M, n8 = NCLUSTERS, 8 * NSTATIONS
     mp = pad_to(M, 8)
     rows = data.vis.shape[-1]
-    rowsp = pad_to(rows, tile)
+    rowsp = chunked_rowsp(rows, tile)
     antp = np.zeros((1, rowsp), np.int32)
     antq = np.zeros((1, rowsp), np.int32)
     antp[0, :rows] = np.asarray(data.ant_p)
@@ -223,7 +237,8 @@ def make_fused_step(data, nu=5.0, tile=512):
         def cost_fn(pflat):
             jones = params_to_jones(pflat.reshape(M, 1, n8))[:, 0]
             tre, tim = pack_gain_tables(jones, mp)
-            model = fused_predict_packed(tre, tim, coh_c, antp_d, antq_d, tile)
+            model = fused_predict_packed_chunked(
+                tre, tim, coh_c, antp_d, antq_d, tile)
             d = (vis_p - model) * mask_p[:, None, :]
             e2 = d[:, :4, :] ** 2 + d[:, 4:, :] ** 2
             return jnp.sum(jnp.log1p(e2 / nu))
@@ -388,6 +403,9 @@ def main():
     # LBFGS solve on this single-core host) and compare against its own
     # pinned baseline
     on_tpu = platform not in ("cpu",)
+    if _FUSED_ENV is None:
+        global FUSED
+        FUSED = on_tpu
     tilesz = TILESZ if on_tpu else 5
     repeats = REPEATS if on_tpu else 1
     value, iters, dt, xla_flops = run(

@@ -1,3 +1,22 @@
+"""On-chip fused-kernel shape ladder (round-5 hardware findings baked in).
+
+Hardware-measured compile behavior on the axon remote-compile relay
+(v5e, 2026-07-31):
+  - scoped-VMEM stack scales with Mp * tile: at mp=104 the FORWARD
+    OOMs the 16 MB limit at tile=512 (20.9 MB; 256 fits at ~10.5 MB)
+    and the BACKWARD OOMs at tile=256 (19.7 MB; 128 fits) — hence
+    FULL_CLUSTER_TILE = 128 for any differentiated path.
+  - what looked like compile time growing with grid length was mostly
+    the axon AOT relay ingesting jit CLOSURE constants at ~2 MB/s
+    (726 MB of captured coherencies = ~6 min before Mosaic starts);
+    with arrays passed as arguments the full chunked forward compiles
+    in ~31 s.  Rows are still chunked (lax.map over MAX_GRID_ROWS
+    blocks) to keep each Mosaic grid short.
+  - steady-state dispatch has a ~65 ms floor (tunnel round-trip), so
+    per-call timings here are upper bounds on kernel compute.
+"full" runs the north-star shape the way the bench does: tile=128,
+4 chunks x 28416 rows (R=222 per grid).
+"""
 import sys
 import time
 
@@ -9,12 +28,17 @@ import jax.numpy as jnp
 jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-from sagecal_tpu.ops.rime_kernel import fused_predict_packed  # noqa: E402
+from sagecal_tpu.ops.rime_kernel import (  # noqa: E402
+    FULL_CLUSTER_TILE,
+    chunked_rowsp,
+    fused_predict_packed,
+    fused_predict_packed_chunked,
+)
 
 TILE, MC = 512, 8
 
 
-def run(mp, F, rowsp, ns=62):
+def run(mp, F, rowsp, ns=62, tile=TILE, chunked=False):
     rng = np.random.default_rng(0)
     coh = rng.standard_normal((mp, F, 8, rowsp)).astype(np.float32)
     tre = rng.standard_normal((4, mp, 128)).astype(np.float32)
@@ -25,19 +49,24 @@ def run(mp, F, rowsp, ns=62):
     coh, tre, tim, antp, antq = (
         jax.device_put(a, dev) for a in (coh, tre, tim, antp, antq)
     )
+    predict = fused_predict_packed_chunked if chunked else fused_predict_packed
 
+    # Big arrays enter as ARGUMENTS, not closure constants: the axon AOT
+    # relay ingests closure constants at ~2 MB/s (round-5 finding — the
+    # "compile-time grid scaling" was really closure size: 726 MB of
+    # captured coherencies = ~6 min before Mosaic even starts).
     @jax.jit
-    def f(tre, tim):
-        return jnp.sum(fused_predict_packed(tre, tim, coh, antp, antq, TILE))
+    def f(tre, tim, coh, antp, antq):
+        return jnp.sum(predict(tre, tim, coh, antp, antq, tile))
 
     t0 = time.time()
-    v = float(np.asarray(f(tre, tim)))
-    print(f"mp={mp} F={F} rowsp={rowsp}: compile+run {time.time()-t0:.1f}s "
-          f"val={v:.4g}", flush=True)
+    v = float(np.asarray(f(tre, tim, coh, antp, antq)))
+    print(f"mp={mp} F={F} rowsp={rowsp} tile={tile} chunked={chunked}: "
+          f"compile+run {time.time()-t0:.1f}s val={v:.4g}", flush=True)
     ts = []
     for _ in range(3):
         t0 = time.time()
-        float(np.asarray(f(tre, tim)))
+        float(np.asarray(f(tre, tim, coh, antp, antq)))
         ts.append(time.time() - t0)
     dt = sorted(ts)[1]
     print(f"  steady {dt*1e3:.2f} ms  BW {coh.size*4/dt/1e9:.0f} GB/s",
@@ -51,4 +80,11 @@ if __name__ == "__main__":
     elif which == "mid":
         run(40, 2, 32768)
     elif which == "full":
-        run(104, 2, 113664)  # north-star padded shape
+        # north-star shape, production configuration: 113664 rows =
+        # 4 chunks x 28416 (R=222 per grid at tile=128), Mp=104.
+        run(104, 2, chunked_rowsp(113460), tile=FULL_CLUSTER_TILE,
+            chunked=True)
+    elif which == "full1":
+        # single-grid full shape (R=888 at tile=128) — exceeds
+        # practical compile time; kept for relay regression probing.
+        run(104, 2, 113664, tile=FULL_CLUSTER_TILE)

@@ -450,6 +450,80 @@ def _vjp_bwd_h(nc, tile, res, g_ri):
 fused_predict_packed_hybrid.defvjp(_vjp_fwd_h, _vjp_bwd_h)
 
 
+# On-chip VMEM budget (round-5 hardware findings, v5e): the kernel
+# body's scoped stack scales with Mp * tile against a 16 MB scoped-vmem
+# limit.  At the north-star cluster count (Mp=104) the FORWARD needs
+# tile <= 256 (512 -> 20.9 MB FAILS, 256 -> ~10.5 MB ok) and the
+# BACKWARD — which carries 16 (Mp, T) cotangent accumulators — needs
+# tile <= 128 (256 -> 19.7 MB FAILS).  128 is the safe production tile
+# for any differentiated path at full cluster count.  Large row counts
+# are CHUNKED at the XLA level (lax.map) to keep each Mosaic grid
+# short; NOTE the dominant "compile time" observed for big closures was
+# actually the axon AOT relay ingesting closure constants at ~2 MB/s —
+# always pass big arrays as jit ARGUMENTS.
+FULL_CLUSTER_TILE = 128
+MAX_GRID_ROWS = 32768  # rows per lax.map chunk
+
+
+def fused_predict_packed_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
+                                 tile=FULL_CLUSTER_TILE,
+                                 max_rows=MAX_GRID_ROWS):
+    """Full-model predict for row counts too long for one Mosaic grid.
+
+    Splits the row axis into ``n = ceil(rowsp / max_rows)`` equal chunks
+    (caller pads ``rowsp`` to ``n * chunk`` with ``chunked_rowsp``) and
+    ``lax.map``s the fused kernel over them — one kernel compile at a
+    known-good grid length, reused across chunks and LBFGS iterations.
+    Gradients flow to the gain tables through the map like the unchunked
+    call."""
+    _, F, _, rowsp = coh_ri.shape
+    max_rows = _tile_floor(max_rows, tile)
+    if rowsp <= max_rows:
+        return fused_predict_packed(tab_re, tab_im, coh_ri, ant_p, ant_q,
+                                    tile)
+    n = -(-rowsp // max_rows)
+    chunk = rowsp // n
+    if chunk * n != rowsp or chunk % tile:
+        raise ValueError(
+            f"rowsp={rowsp} must be n_chunks*chunk with chunk a multiple "
+            f"of tile={tile}; pad with chunked_rowsp()")
+
+    def one(i):
+        c = jax.lax.dynamic_slice_in_dim(coh_ri, i * chunk, chunk, axis=3)
+        p = jax.lax.dynamic_slice_in_dim(ant_p, i * chunk, chunk, axis=1)
+        q = jax.lax.dynamic_slice_in_dim(ant_q, i * chunk, chunk, axis=1)
+        return fused_predict_packed(tab_re, tab_im,
+                                    jax.lax.stop_gradient(c), p, q, tile)
+
+    out = jax.lax.map(one, jnp.arange(n))        # (n, F, 8, chunk)
+    return out.transpose(1, 2, 0, 3).reshape(F, 8, rowsp)
+
+
+def _tile_floor(max_rows: int, tile: int) -> int:
+    """Largest tile multiple <= max_rows — both chunking functions
+    derive the chunk bound this way so chunked_rowsp() output always
+    satisfies fused_predict_packed_chunked()'s validation."""
+    if max_rows < tile:
+        raise ValueError(f"max_rows={max_rows} smaller than tile={tile}")
+    return max_rows - max_rows % tile
+
+
+def chunked_rowsp(rows: int, tile: int = FULL_CLUSTER_TILE,
+                  max_rows: int = MAX_GRID_ROWS) -> int:
+    """Smallest padded row count that is n equal tile-aligned chunks of
+    at most ``max_rows`` rows (n chosen minimal)."""
+    max_rows = _tile_floor(max_rows, tile)
+    rowsp = pad_to(rows, tile)
+    if rowsp <= max_rows:
+        return rowsp
+    n = -(-rowsp // max_rows)
+    # ceil(rowsp/n) <= max_rows (from n's definition) and max_rows is a
+    # tile multiple, so the tile-padded chunk stays <= max_rows; and
+    # chunk >= rowsp/n > (n-1)*max_rows/n means the consumer recomputes
+    # the same n from chunk*n.
+    return pad_to(-(-rowsp // n), tile) * n
+
+
 # --------------------------------------------------- packing conveniences
 
 
