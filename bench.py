@@ -302,9 +302,12 @@ def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ):
     # through the axon tunnel vs 74 ms for the whole predict once the
     # arrays are device-resident.  device_put once, time steady state.
     dev = jax.devices()[0]
-    if COH_BF16 and not FUSED:
+    if COH_BF16:
         import ml_dtypes
 
+        # fused path: the kernel upcasts bf16 planes to f32 at the VMEM
+        # load (rime_kernel._load_coh_planes); XLA path: make_step
+        # upcasts the whole stack inside the jitted cost
         coh_ri = coh_ri.astype(ml_dtypes.bfloat16)
     args = tuple(jax.device_put(a, dev) for a in (vis_ri, mask, coh_ri, p0_h))
     # NOTE: block_until_ready is a NO-OP on axon; the transfers are
@@ -448,7 +451,7 @@ def main():
     cost_evals = 2 * iters + 2
     fl_eval = analytic_flops_per_cost_eval(tilesz)
     by_eval = hbm_bytes_per_cost_eval(
-        tilesz, coh_bytes_per_cplx=4 if COH_BF16 and not FUSED else 8
+        tilesz, coh_bytes_per_cplx=4 if COH_BF16 else 8
     )
     flops_per_sec = cost_evals * fl_eval / dt
     gbytes_per_sec = cost_evals * by_eval / dt / 1e9
@@ -460,7 +463,7 @@ def main():
         "vs_baseline": round(vs, 3) if vs else None,
         "platform": platform,
         "fused_kernel": FUSED,
-        "coh_bf16": COH_BF16 and not FUSED,
+        "coh_bf16": COH_BF16,
         "cpu_baseline_iters_per_sec": base,
         "cpu_baseline_source": "measured-live" if cpu_measured else "pinned",
         "vs_reference_cpu": round(vs_ref, 3) if vs_ref else None,

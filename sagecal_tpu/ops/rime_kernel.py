@@ -33,10 +33,14 @@ with ``jax.custom_vjp``.  Gradients flow to the gain tables only: the
 solver never differentiates w.r.t. coherencies (per-tile constants, like
 the reference's precalculated ``coh`` array).
 
-Everything crosses the kernel boundary as REAL f32 (re/im packed on a
-leading axis): the axon TPU runtime cannot transfer complex arrays, and
-packed reals keep every buffer's minor-most axis long (rows), so the
-TPU (8, 128) tiling pads nothing (core/types.py layout rationale).
+Everything crosses the kernel boundary as REAL arrays (re/im packed on
+a leading axis): the axon TPU runtime cannot transfer complex arrays,
+and packed reals keep every buffer's minor-most axis long (rows), so
+the TPU (8, 128) tiling pads nothing (core/types.py layout rationale).
+Gain tables and outputs are f32; ``coh_ri`` may be f32 or bfloat16 —
+bf16 planes are upcast to f32 at the VMEM load (``_load_coh_planes``),
+halving the dominant HBM stream at ~3 significant digits of coherency
+precision (a throughput knob, not the production default).
 
 Layout contracts:
   tab_re/tab_im: (4, Mp*nc, NPAD) component-major gain tables — plane k
@@ -157,11 +161,19 @@ def _onehots(antp_ref, antq_ref, T):
     return ohp, ohq
 
 
+def _load_coh_planes(coh_ref, f):
+    """Load one frequency's 4 re + 4 im coherency planes, upcasting to
+    f32 at the VMEM load so a bfloat16 coherency stack (halved HBM
+    stream — the bandwidth-bound knob) computes in full f32."""
+    c_re = [coh_ref[:, f, k, :].astype(jnp.float32) for k in range(4)]
+    c_im = [coh_ref[:, f, 4 + k, :].astype(jnp.float32) for k in range(4)]
+    return c_re, c_im
+
+
 def _fwd_store(coh_ref, out_ref, p_re, p_im, q_re, q_im, F):
     # per-plane (1, T) slice stores — no stack/concatenate relayouts
     for f in range(F):
-        c_re = [coh_ref[:, f, k, :] for k in range(4)]
-        c_im = [coh_ref[:, f, 4 + k, :] for k in range(4)]
+        c_re, c_im = _load_coh_planes(coh_ref, f)
         v_re, v_im = _rime_products(c_re, c_im, p_re, p_im, q_re, q_im)
         for k in range(4):
             out_ref[f, k:k + 1, :] = jnp.sum(v_re[k], axis=0, keepdims=True)
@@ -252,8 +264,7 @@ def _bwd_accumulate(coh_ref, g_ref, p_re, p_im, q_re, q_im, F, MP, T):
     djq_im = [jnp.zeros((MP, T), jnp.float32) for _ in range(4)]
 
     for f in range(F):
-        c_re = [coh_ref[:, f, k, :] for k in range(4)]
-        c_im = [coh_ref[:, f, 4 + k, :] for k in range(4)]
+        c_re, c_im = _load_coh_planes(coh_ref, f)
         g_re = [g_ref[f, k:k + 1, :] for k in range(4)]  # (1, T)
         g_im = [g_ref[f, 4 + k:5 + k, :] for k in range(4)]
 

@@ -308,3 +308,35 @@ def test_chunked_rowsp_values():
     # north-star rows: 4 equal chunks of 28416 (R=111 at tile 256)
     assert chunked_rowsp(113460, 256, 32768) == 113664
     assert chunked_rowsp(113460, 256, 32768) % 4 == 0
+
+
+def test_bf16_coherencies_close_to_f32():
+    """The kernel upcasts bfloat16 coherency planes to f32 at the VMEM
+    load (_load_coh_planes) — the bandwidth-bound production knob.
+    bf16 carries ~3 significant digits; the result must track the f32
+    kernel to that precision, and gradients must flow."""
+    import ml_dtypes
+
+    jones, coh, ant_p, ant_q, coh_ri, antp, antq, mp, rowsp = _random_problem(
+        seed=7
+    )
+    tab_re, tab_im = pack_gain_tables(jnp.asarray(jones), mp)
+    args32 = (jnp.asarray(coh_ri), jnp.asarray(antp), jnp.asarray(antq))
+    args16 = (jnp.asarray(coh_ri.astype(ml_dtypes.bfloat16)),) + args32[1:]
+
+    ref = np.asarray(fused_predict_packed(tab_re, tab_im, *args32, TILE))
+    got = np.asarray(fused_predict_packed(tab_re, tab_im, *args16, TILE))
+    scale = np.abs(ref).max()
+    assert np.abs(got - ref).max() / scale < 2e-2
+
+    g32 = jax.grad(
+        lambda a, b: jnp.sum(fused_predict_packed(a, b, *args32, TILE) ** 2),
+        argnums=(0, 1),
+    )(tab_re, tab_im)
+    g16 = jax.grad(
+        lambda a, b: jnp.sum(fused_predict_packed(a, b, *args16, TILE) ** 2),
+        argnums=(0, 1),
+    )(tab_re, tab_im)
+    for a, b in zip(g32, g16):
+        s = np.abs(np.asarray(a)).max()
+        assert np.abs(np.asarray(a) - np.asarray(b)).max() / s < 3e-2
