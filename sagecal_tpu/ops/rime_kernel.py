@@ -476,6 +476,27 @@ FULL_CLUSTER_TILE = 128
 MAX_GRID_ROWS = 32768  # rows per lax.map chunk
 
 
+def _chunk_plan(rowsp: int, tile: int, max_rows: int):
+    """(n_chunks, chunk) for splitting ``rowsp`` rows, or None when one
+    grid suffices.  Single copy of the math shared by both chunked
+    wrappers; chunked_rowsp() pads so the validation always holds."""
+    max_rows = _tile_floor(max_rows, tile)
+    if rowsp <= max_rows:
+        return None
+    n = -(-rowsp // max_rows)
+    chunk = rowsp // n
+    if chunk * n != rowsp or chunk % tile:
+        raise ValueError(
+            f"rowsp={rowsp} must be n_chunks*chunk with chunk a multiple "
+            f"of tile={tile}; pad with chunked_rowsp()")
+    return n, chunk
+
+
+def _map_row_chunks(one, n, chunk, F, rowsp):
+    out = jax.lax.map(one, jnp.arange(n))        # (n, F, 8, chunk)
+    return out.transpose(1, 2, 0, 3).reshape(F, 8, rowsp)
+
+
 def fused_predict_packed_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
                                  tile=FULL_CLUSTER_TILE,
                                  max_rows=MAX_GRID_ROWS):
@@ -488,16 +509,11 @@ def fused_predict_packed_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
     Gradients flow to the gain tables through the map like the unchunked
     call."""
     _, F, _, rowsp = coh_ri.shape
-    max_rows = _tile_floor(max_rows, tile)
-    if rowsp <= max_rows:
+    plan = _chunk_plan(rowsp, tile, max_rows)
+    if plan is None:
         return fused_predict_packed(tab_re, tab_im, coh_ri, ant_p, ant_q,
                                     tile)
-    n = -(-rowsp // max_rows)
-    chunk = rowsp // n
-    if chunk * n != rowsp or chunk % tile:
-        raise ValueError(
-            f"rowsp={rowsp} must be n_chunks*chunk with chunk a multiple "
-            f"of tile={tile}; pad with chunked_rowsp()")
+    n, chunk = plan
 
     def one(i):
         c = jax.lax.dynamic_slice_in_dim(coh_ri, i * chunk, chunk, axis=3)
@@ -506,8 +522,32 @@ def fused_predict_packed_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
         return fused_predict_packed(tab_re, tab_im,
                                     jax.lax.stop_gradient(c), p, q, tile)
 
-    out = jax.lax.map(one, jnp.arange(n))        # (n, F, 8, chunk)
-    return out.transpose(1, 2, 0, 3).reshape(F, 8, rowsp)
+    return _map_row_chunks(one, n, chunk, F, rowsp)
+
+
+def fused_predict_packed_hybrid_chunked(tab_re, tab_im, coh_ri, ant_p,
+                                        ant_q, cmap, nc,
+                                        tile=FULL_CLUSTER_TILE,
+                                        max_rows=MAX_GRID_ROWS):
+    """Hybrid-chunk (nc > 1) analog of fused_predict_packed_chunked:
+    ``cmap`` (Mp, rowsp) is sliced along the row axis with the other
+    per-row arrays."""
+    _, F, _, rowsp = coh_ri.shape
+    plan = _chunk_plan(rowsp, tile, max_rows)
+    if plan is None:
+        return fused_predict_packed_hybrid(tab_re, tab_im, coh_ri, ant_p,
+                                           ant_q, cmap, nc, tile)
+    n, chunk = plan
+
+    def one(i):
+        c = jax.lax.dynamic_slice_in_dim(coh_ri, i * chunk, chunk, axis=3)
+        p = jax.lax.dynamic_slice_in_dim(ant_p, i * chunk, chunk, axis=1)
+        q = jax.lax.dynamic_slice_in_dim(ant_q, i * chunk, chunk, axis=1)
+        cm = jax.lax.dynamic_slice_in_dim(cmap, i * chunk, chunk, axis=1)
+        return fused_predict_packed_hybrid(
+            tab_re, tab_im, jax.lax.stop_gradient(c), p, q, cm, nc, tile)
+
+    return _map_row_chunks(one, n, chunk, F, rowsp)
 
 
 def _tile_floor(max_rows: int, tile: int) -> int:
@@ -565,18 +605,20 @@ def pack_gain_tables(jones, mp: int):
 
 
 def pack_predict_inputs(vis, mask, coh, ant_p, ant_q, chunk_map=None,
-                        tile=DEF_TILE):
+                        tile=DEF_TILE, max_rows=None):
     """Pad/pack complex (F, 4, rows) visibilities, (M, F, 4, rows)
     coherencies, mask and antenna indices into the kernel's layout
-    contract: rows padded to a multiple of ``tile``, clusters padded to
-    a multiple of 8, re/im concatenated on the component axis, ant
-    indices as (1, rowsp) int32.  Returns
+    contract: rows padded to a multiple of ``tile`` (or to equal
+    tile-aligned ``max_rows`` chunks for the chunked kernels, when
+    given), clusters padded to a multiple of 8, re/im concatenated on
+    the component axis, ant indices as (1, rowsp) int32.  Returns
     (vis_ri, mask_p, coh_ri, antp, antq, cmap_or_None).  jnp-based: use
     inside jit (padded regions carry zero coherency and zero mask, so
     they contribute nothing to any cost or gradient)."""
     M, rows = coh.shape[0], coh.shape[-1]
     mp = pad_to(M, 8)
-    rowsp = pad_to(rows, tile)
+    rowsp = (chunked_rowsp(rows, tile, max_rows) if max_rows
+             else pad_to(rows, tile))
     pad_r = rowsp - rows
     coh_ri = jnp.concatenate(
         [jnp.real(coh), jnp.imag(coh)], axis=-2

@@ -420,8 +420,9 @@ def _make_fused_joint_cost(data, cdata, M, nchunk_max, n8, robust, mean_nu):
     arrays are built ONCE here (they are constants of the LBFGS loop).
     f32 only: the kernel computes in float32."""
     from sagecal_tpu.ops.rime_kernel import (
-        DEF_TILE, fused_predict_packed, fused_predict_packed_hybrid,
-        pack_gain_tables, pack_predict_inputs, pad_to,
+        FULL_CLUSTER_TILE, MAX_GRID_ROWS, fused_predict_packed_chunked,
+        fused_predict_packed_hybrid_chunked, pack_gain_tables,
+        pack_predict_inputs, pad_to,
     )
 
     if jnp.real(data.vis).dtype != jnp.float32:
@@ -429,10 +430,15 @@ def _make_fused_joint_cost(data, cdata, M, nchunk_max, n8, robust, mean_nu):
             "use_fused_predict requires float32 data (the Pallas kernel "
             "computes in f32); run with f64 disabled or use the XLA path"
         )
+    # FULL_CLUSTER_TILE (128) is the largest tile whose BACKWARD kernel
+    # fits the v5e 16 MB scoped-VMEM limit at ~100 clusters, and rows
+    # are chunked so each Mosaic grid stays short — the hardware-proven
+    # production configuration (PERF.md).
     mp = pad_to(M, 8)
     vis_ri, mask_p, coh_ri, antp, antq, cmap = pack_predict_inputs(
         data.vis, data.mask, cdata.coh, data.ant_p, data.ant_q,
-        cdata.chunk_map if nchunk_max > 1 else None, DEF_TILE,
+        cdata.chunk_map if nchunk_max > 1 else None, FULL_CLUSTER_TILE,
+        max_rows=MAX_GRID_ROWS,
     )
     coh_c = jax.lax.stop_gradient(coh_ri)
 
@@ -442,13 +448,16 @@ def _make_fused_joint_cost(data, cdata, M, nchunk_max, n8, robust, mean_nu):
         )  # (M, nchunk, N, 2, 2)
         if nchunk_max > 1:
             tre, tim = pack_gain_tables(jones, mp)
-            model = fused_predict_packed_hybrid(
-                tre, tim, coh_c, antp, antq, cmap, nchunk_max, DEF_TILE
+            model = fused_predict_packed_hybrid_chunked(
+                tre, tim, coh_c, antp, antq, cmap, nchunk_max,
+                FULL_CLUSTER_TILE, MAX_GRID_ROWS,
             )
         else:
             tre, tim = pack_gain_tables(jones[:, 0], mp)
-            model = fused_predict_packed(tre, tim, coh_c, antp, antq,
-                                         DEF_TILE)
+            model = fused_predict_packed_chunked(
+                tre, tim, coh_c, antp, antq, FULL_CLUSTER_TILE,
+                MAX_GRID_ROWS,
+            )
         d = (vis_ri - model) * mask_p[:, None, :]
         e2 = d[:, :4, :] ** 2 + d[:, 4:, :] ** 2
         if robust:

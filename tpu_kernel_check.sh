@@ -22,6 +22,9 @@ step bisect-a 200 python kbisect.py a
 step bisect-f 200 python kbisect.py f
 step kernel-fwd-small 300 python kbisect.py d
 step kernel-bwd-small 300 python kbisect.py e
+# production config: tile=128, rows chunked (lax.map) - PERF.md
 step kernel-full-shape 560 python kdiag.py full
-echo "=== fused bench (north-star)"
-if probe; then SAGECAL_BENCH_FUSED=1 timeout 560 python bench.py; fi
+echo "=== fused bench (north-star; fused is the TPU default)"
+if probe; then timeout 560 python bench.py; fi
+echo "=== bf16-coherency fused bench"
+if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
