@@ -167,22 +167,26 @@ def make_step(data, cdata, nu=5.0):
 
     @jax.jit
     def step(vis_ri, mask, coh_ri, p0):
-        vis = jax.lax.complex(vis_ri[:, :4, :], vis_ri[:, 4:, :])
-        # upcast to the RUN dtype (bf16 -> f32 under COH_BF16; keeps
-        # the f64 CPU-baseline path genuinely f64)
-        coh_f = coh_ri.astype(vis_ri.dtype)
-        coh = jax.lax.complex(coh_f[:, :, :4, :], coh_f[:, :, 4:, :])
-        d = data.replace(vis=vis, mask=mask)
-        c = cdata._replace(coh=coh)
+        # true-f32 linear algebra (TPU f32 matmuls default to bf16 MXU
+        # passes; the production solver runs HIGHEST — bench the same)
+        with jax.default_matmul_precision("highest"):
+            vis = jax.lax.complex(vis_ri[:, :4, :], vis_ri[:, 4:, :])
+            # upcast to the RUN dtype (bf16 -> f32 under COH_BF16;
+            # keeps the f64 CPU-baseline path genuinely f64)
+            coh_f = coh_ri.astype(vis_ri.dtype)
+            coh = jax.lax.complex(coh_f[:, :, :4, :], coh_f[:, :, 4:, :])
+            d = data.replace(vis=vis, mask=mask)
+            c = cdata._replace(coh=coh)
 
-        def cost_fn(pflat):
-            pa = pflat.reshape(M, nchunk, n8)
-            model = predict_full_model(pa, c, d)
-            diff = (vis - model) * mask[:, None, :]
-            e2 = jnp.real(diff) ** 2 + jnp.imag(diff) ** 2
-            return jnp.sum(jnp.log1p(e2 / nu))
+            def cost_fn(pflat):
+                pa = pflat.reshape(M, nchunk, n8)
+                model = predict_full_model(pa, c, d)
+                diff = (vis - model) * mask[:, None, :]
+                e2 = jnp.real(diff) ** 2 + jnp.imag(diff) ** 2
+                return jnp.sum(jnp.log1p(e2 / nu))
 
-        fit = lbfgs_fit(cost_fn, None, p0.reshape(-1), itmax=LBFGS_ITERS, M=7)
+            fit = lbfgs_fit(cost_fn, None, p0.reshape(-1),
+                            itmax=LBFGS_ITERS, M=7)
         return fit.p, fit.cost, fit.iterations
 
     return step
@@ -232,18 +236,22 @@ def make_fused_step(data, nu=5.0, tile=None):
 
     @jax.jit
     def step(vis_p, mask_p, coh_p, antp_d, antq_d, p0):
-        coh_c = jax.lax.stop_gradient(coh_p)
+        # kernel dots are HIGHEST internally; this covers the LBFGS
+        # two-loop/line-search vector algebra (production precision)
+        with jax.default_matmul_precision("highest"):
+            coh_c = jax.lax.stop_gradient(coh_p)
 
-        def cost_fn(pflat):
-            jones = params_to_jones(pflat.reshape(M, 1, n8))[:, 0]
-            tre, tim = pack_gain_tables(jones, mp)
-            model = fused_predict_packed_chunked(
-                tre, tim, coh_c, antp_d, antq_d, tile)
-            d = (vis_p - model) * mask_p[:, None, :]
-            e2 = d[:, :4, :] ** 2 + d[:, 4:, :] ** 2
-            return jnp.sum(jnp.log1p(e2 / nu))
+            def cost_fn(pflat):
+                jones = params_to_jones(pflat.reshape(M, 1, n8))[:, 0]
+                tre, tim = pack_gain_tables(jones, mp)
+                model = fused_predict_packed_chunked(
+                    tre, tim, coh_c, antp_d, antq_d, tile)
+                d = (vis_p - model) * mask_p[:, None, :]
+                e2 = d[:, :4, :] ** 2 + d[:, 4:, :] ** 2
+                return jnp.sum(jnp.log1p(e2 / nu))
 
-        fit = lbfgs_fit(cost_fn, None, p0.reshape(-1), itmax=LBFGS_ITERS, M=7)
+            fit = lbfgs_fit(cost_fn, None, p0.reshape(-1),
+                            itmax=LBFGS_ITERS, M=7)
         return fit.p, fit.cost, fit.iterations
 
     return prep, step

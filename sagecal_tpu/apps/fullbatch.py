@@ -33,7 +33,7 @@ from sagecal_tpu.solvers.sage import (
     SageConfig,
     build_cluster_data,
     build_cluster_data_withbeam,
-    sagefit,
+    solve_tile,
 )
 
 
@@ -101,7 +101,31 @@ def _beam_setup(cfg: RunConfig, ds: VisDataset):
 
 def run_fullbatch(cfg: RunConfig, log=print):
     """Calibrate (or simulate) every tile of the dataset.  Returns the
-    per-tile (res_0, res_1) list."""
+    per-tile (res_0, res_1) list.
+
+    Device split: every host stage — IO, coherency precompute,
+    residuals, bookkeeping (some of it complex math the axon runtime
+    cannot transfer) — runs under a CPU default device; each tile's
+    SAGE solve crosses to the accelerator as ONE packed-real jit
+    dispatch (solvers/sage.py solve_tile), mirroring the reference's
+    CPU-pipeline + GPU-solver split (fullbatch_mode.cpp:371-464)."""
+    import jax
+
+    from sagecal_tpu.utils.platform import cpu_device
+
+    try:
+        accel = jax.devices()[0]
+    except RuntimeError:
+        # accelerator plugin failed to initialize — cpu_device() below
+        # forces the CPU platform and the whole run stays host-side
+        accel = None
+    if accel is not None and accel.platform == "cpu":
+        accel = None
+    with jax.default_device(cpu_device()):
+        return _run_fullbatch_host(cfg, log, accel)
+
+
+def _run_fullbatch_host(cfg: RunConfig, log, accel):
     dtype = np.float64 if cfg.use_f64 else np.float32
     cdtype = np.complex128 if cfg.use_f64 else np.complex64
     ds = VisDataset(cfg.dataset, "r+")
@@ -259,7 +283,12 @@ def run_fullbatch(cfg: RunConfig, log=print):
             data = data.replace(vis=data.vis * wts[None, None, :],
                                 mask=data.mask * (wts[None, :] > 0))
         with timer.phase("solve"):
-            out = sagefit(data, cdata, p, scfg)  # async dispatch
+            # packed-real boundary: the whole SAGE/EM solve is one jit
+            # dispatch to the default device — complex never crosses, so
+            # this runs on the axon TPU as-is (solvers/sage.py
+            # sagefit_packed)
+            out = solve_tile(data, cdata, p, scfg,
+                             device=accel)  # async dispatch
         # overlap: next tile's load + coherency dispatch runs while the
         # device solves this tile
         if pi + 1 < len(pairs):
@@ -271,7 +300,9 @@ def run_fullbatch(cfg: RunConfig, log=print):
         diverged = (
             not np.isfinite(res1) or res1 == 0.0 or res1 > cfg.res_ratio * res0
         )
-        p = pinit if diverged else out.p
+        # out.p comes home as real numpy so all downstream eager math
+        # (params_to_jones, residuals) stays on the CPU device
+        p = pinit if diverged else jnp.asarray(np.asarray(out.p))
         if diverged:
             log(f"tile {t0}: diverged ({res0:.3e} -> {res1:.3e}), reset")
 

@@ -70,12 +70,28 @@ def _use_interpret() -> bool:
     return jax.default_backend() not in ("tpu",)
 
 
+def _sel_dot(t, oh):
+    """Selection matmul ``t @ oh`` (``oh`` 0/1 one-hot) in exact f32.
+
+    The TPU MXU multiplies f32 as bf16 passes by default, rounding
+    every selected value to ~3 digits (measured 3.7e-3 rel error at
+    the kernel output on the v5e) — enough to diverge warm-started
+    calibration tiles.  Precision.HIGHEST restores exact f32 (1e-7).
+    A 2-pass hi/lo split (exact selections, 4.8e-6 rel) was tried and
+    MEASURED SLOWER whole-bench (28.8 vs 32.7 it/s): the VPU
+    decomposition costs more than the four MXU passes it saves, on
+    either operand size.  Mosaic does not support Precision.HIGH."""
+    return jnp.dot(t, oh, preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)
+
+
 def _expand_gains(tabre_ref, tabim_ref, oh, mp, T, nc=1, cmap=None):
     """(4, Mp*nc, NPAD) component-major tables x (NPAD, T) one-hot ->
-    4 re + 4 im (Mp, T) per-row gain components, one MXU matmul per
-    component — NO sublane reshapes in the nc=1 path (kept Mosaic-
-    friendly on purpose: minor-dim relayouts are a prime suspect in the
-    remote-compile stall documented in the verify skill).
+    4 re + 4 im (Mp, T) per-row gain components, one MXU selection per
+    component (see _sel_dot) — NO sublane reshapes in the nc=1 path
+    (kept Mosaic-friendly on purpose: minor-dim relayouts are a prime
+    suspect in the remote-compile stall documented in the verify
+    skill).
 
     ``nc > 1`` is the reference's hybrid time-chunk mode (one solution
     per chunk of the tile, lmfit.c:86-87): the tables carry one row
@@ -84,15 +100,13 @@ def _expand_gains(tabre_ref, tabim_ref, oh, mp, T, nc=1, cmap=None):
     re, im = [], []
     if nc == 1:
         for k in range(4):
-            re.append(jnp.dot(tabre_ref[k], oh,
-                              preferred_element_type=jnp.float32))
-            im.append(jnp.dot(tabim_ref[k], oh,
-                              preferred_element_type=jnp.float32))
+            re.append(_sel_dot(tabre_ref[k], oh))
+            im.append(_sel_dot(tabim_ref[k], oh))
         return re, im
     sels = [(cmap == c).astype(jnp.float32) for c in range(nc)]  # (Mp, T)
     for k in range(4):
-        g_re = jnp.dot(tabre_ref[k], oh, preferred_element_type=jnp.float32)
-        g_im = jnp.dot(tabim_ref[k], oh, preferred_element_type=jnp.float32)
+        g_re = _sel_dot(tabre_ref[k], oh)
+        g_im = _sel_dot(tabim_ref[k], oh)
         gr = g_re.reshape(mp, nc, T)  # leading-dim split only
         gi = g_im.reshape(mp, nc, T)
         acc_r = acc_i = 0.0
@@ -107,10 +121,16 @@ def _expand_gains(tabre_ref, tabim_ref, oh, mp, T, nc=1, cmap=None):
 def _rowsum_dot(a, b):
     """(Mp', T) x (NPAD, T) -> (Mp', NPAD), contracting T — dot_general
     with the contraction on the trailing dims so no transpose op is
-    ever materialized."""
+    ever materialized.  Precision.HIGHEST, NOT the _sel_dot hi/lo
+    trick: here the split would run on the big (Mp, T) cotangent
+    operand, and the VPU decomposition costs more than the four MXU
+    passes it saves (measured 27.4 vs 32.7 it/s whole-bench on the
+    v5e).  HIGHEST keeps the accumulated gain-table cotangents exact
+    f32."""
     return jax.lax.dot_general(
         a, b, dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
 
 

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from sagecal_tpu.core.types import VisData
 from sagecal_tpu.solvers.lm import LMConfig, _residual_flat, lm_solve
 from sagecal_tpu.solvers.robust import update_w_and_nu
+from sagecal_tpu.utils.precision import true_f32
 from sagecal_tpu.solvers.sage import (
     SM_LM_LBFGS,
     SM_NSD_RLBFGS,
@@ -47,6 +48,7 @@ class AdmmLocalResult(NamedTuple):
     res_1: jax.Array
 
 
+@true_f32
 def admm_sagefit(
     data: VisData,
     cdata: ClusterData,

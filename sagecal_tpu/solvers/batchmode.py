@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from sagecal_tpu.core.types import VisData
 from sagecal_tpu.solvers.lbfgs import LBFGSMemory, LBFGSResult, lbfgs_fit
 from sagecal_tpu.solvers.sage import ClusterData, predict_full_model
+from sagecal_tpu.utils.precision import true_f32
 
 
 def _data_cost(pflat, data: VisData, cdata: ClusterData, shape, robust_nu):
@@ -37,6 +38,7 @@ def _data_cost(pflat, data: VisData, cdata: ClusterData, shape, robust_nu):
     return jnp.sum(e2)
 
 
+@true_f32
 def bfgsfit_minibatch(
     data: VisData,
     cdata: ClusterData,
@@ -66,6 +68,7 @@ def bfgsfit_minibatch(
     return fit.p.reshape(shape), fit.memory
 
 
+@true_f32
 def bfgsfit_minibatch_consensus(
     data: VisData,
     cdata: ClusterData,

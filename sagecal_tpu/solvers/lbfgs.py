@@ -27,6 +27,7 @@ from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+from sagecal_tpu.utils.precision import true_f32
 from flax import struct
 
 CLM_STOP_THRESH = 1e-9
@@ -141,6 +142,7 @@ class LBFGSResult(NamedTuple):
     iterations: jax.Array
 
 
+@true_f32
 def lbfgs_fit(
     cost_fn: Callable,
     grad_fn: Optional[Callable],

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from sagecal_tpu.solvers.lbfgs import LBFGSMemory, _two_loop_direction
+from sagecal_tpu.utils.precision import true_f32
 
 
 class LBFGSBResult(NamedTuple):
@@ -65,6 +66,7 @@ def _cauchy_point(x, g, lb, ub, theta):
     return xc, ~at_bound
 
 
+@true_f32
 def lbfgsb_fit(
     cost_fn: Callable[[jax.Array], jax.Array],
     grad_fn: Optional[Callable[[jax.Array], jax.Array]],

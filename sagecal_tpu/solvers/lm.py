@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from sagecal_tpu.core.types import corrupt_flat, params_to_jones, reals_of_flat
+from sagecal_tpu.utils.precision import true_f32
 
 # Row-block size for the Jacobian-assembly scan: bounds the per-block
 # (RB, F*8, 8) Jacobian intermediates so assembly memory is O(block), not
@@ -201,6 +202,7 @@ def _solve_spd(A, b):
     return jax.vmap(chol_solve)(A, b)
 
 
+@true_f32
 def lm_solve(
     vis: jax.Array,
     coh: jax.Array,
@@ -324,6 +326,7 @@ def lm_solve(
     return LMResult(p=p, cost0=cost0, cost=cost, iterations=it)
 
 
+@true_f32
 def os_lm_solve(
     vis, coh, mask, ant_p, ant_q, chunk_map, p0,
     config: LMConfig = LMConfig(),

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax.scipy.special import digamma
 
 from sagecal_tpu.solvers.lm import LMConfig, LMResult, _residual_flat, lm_solve
+from sagecal_tpu.utils.precision import true_f32
 
 
 def update_w_and_nu(
@@ -82,6 +83,7 @@ def update_nu_aecm(
     return grid[jnp.argmin(jnp.abs(score))].astype(jnp.result_type(nu_old))
 
 
+@true_f32
 def robust_lm_solve(
     vis, coh, mask, ant_p, ant_q, chunk_map, p0,
     nu0: float = 2.0,

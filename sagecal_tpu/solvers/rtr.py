@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from sagecal_tpu.core.types import params_to_jones, jones_to_params
+from sagecal_tpu.utils.precision import true_f32
 
 
 @struct.dataclass
@@ -483,6 +484,7 @@ def _chunked(solver):
     return run
 
 
+@true_f32
 def rtr_solve(
     vis, coh, mask, ant_p, ant_q, chunk_map, p0,
     config: RTRConfig = RTRConfig(),
@@ -506,6 +508,7 @@ def rtr_solve(
     )
 
 
+@true_f32
 def nsd_solve(
     vis, coh, mask, ant_p, ant_q, chunk_map, p0,
     itmax: int = 10,
@@ -547,6 +550,7 @@ def _robust_weights_and_nu(
     return jnp.sqrt(w)[..., None, :], nu1
 
 
+@true_f32
 def rtr_solve_robust(
     vis, coh, mask, ant_p, ant_q, chunk_map, p0,
     config: RTRConfig = RTRConfig(),
@@ -590,6 +594,7 @@ def rtr_solve_robust(
     return RTRResult(p=p, cost0=c0s[0], cost=c1s[-1]), nu
 
 
+@true_f32
 def nsd_solve_robust(
     vis, coh, mask, ant_p, ant_q, chunk_map, p0,
     itmax: int = 10,

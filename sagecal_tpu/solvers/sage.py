@@ -46,6 +46,7 @@ from sagecal_tpu.ops.rime import SourceBatch, predict_coherencies
 from sagecal_tpu.solvers.lbfgs import lbfgs_fit
 from sagecal_tpu.solvers.lm import LMConfig, lm_solve, os_lm_solve
 from sagecal_tpu.solvers.robust import robust_lm_solve
+from sagecal_tpu.utils.precision import true_f32
 
 # solver modes (values match Dirac.h:1607-1613)
 SM_OSLM_LBFGS = 0
@@ -467,6 +468,7 @@ def _make_fused_joint_cost(data, cdata, M, nchunk_max, n8, robust, mean_nu):
     return cost_fn
 
 
+@true_f32
 def sagefit(
     data: VisData,
     cdata: ClusterData,
@@ -638,3 +640,71 @@ def sagefit(
     return SageResult(
         p=p, res_0=res_0, res_1=res_1, mean_nu=mean_nu, diverged=res_1 > res_0
     )
+
+
+# ------------------------------------------------ packed device boundary
+
+
+def sagefit_packed(
+    data: VisData,
+    cdata: ClusterData,
+    vis_re: jax.Array,
+    vis_im: jax.Array,
+    coh_re: jax.Array,
+    coh_im: jax.Array,
+    p0: jax.Array,
+    config: SageConfig = SageConfig(),
+    key: Optional[jax.Array] = None,
+) -> SageResult:
+    """The whole tile solve behind a REAL-array jit boundary.
+
+    ``sagefit`` is fully traceable, but its natural signature carries
+    complex visibilities/coherencies — which cannot cross the axon TPU
+    host<->device boundary (UNIMPLEMENTED; verify-skill gotcha 3).
+    This wrapper takes ``data`` with ``vis=None`` and ``cdata`` with
+    ``coh=None`` plus separate re/im leaves (``(F, 4, rows)`` /
+    ``(M, F, 4, rows)``, rows minor-most so TPU tiling pads nothing)
+    and rebuilds the complex arrays INSIDE the trace.  Every input and
+    output leaf is real, so ``jax.jit(sagefit_packed)`` dispatches the
+    full SAGE/EM tile solve — EM passes, per-cluster solvers, joint
+    LBFGS, nu estimation — to the TPU as ONE program (also amortizing
+    the ~65 ms axon dispatch floor once per tile; PERF.md).
+
+    Matmul precision comes from the ``true_f32`` decorator on
+    ``sagefit`` and every other solver entry (utils/precision.py)."""
+    vis = jax.lax.complex(vis_re, vis_im)
+    coh = jax.lax.complex(coh_re, coh_im)
+    return sagefit(
+        data.replace(vis=vis), cdata._replace(coh=coh), p0, config, key
+    )
+
+
+_sagefit_packed_jit = jax.jit(sagefit_packed)
+
+
+def solve_tile(
+    data: VisData,
+    cdata: ClusterData,
+    p0: jax.Array,
+    config: SageConfig = SageConfig(),
+    key: Optional[jax.Array] = None,
+    device=None,
+) -> SageResult:
+    """Host convenience around :func:`sagefit_packed`: splits re/im on
+    the host (numpy views — no eager device ops and no concatenated
+    double-size host buffer, safe under an axon default device) and
+    dispatches the jitted packed solve.  Complex never crosses the
+    boundary; on CPU this is the same math as ``sagefit``.
+
+    ``device``: explicit target (e.g. the TPU chip while the rest of
+    the pipeline runs host-side under a CPU default device — the
+    fullbatch split).  Every input leaf is device_put there, including
+    previously host-committed template arrays."""
+    vis = np.asarray(data.vis)
+    coh = np.asarray(cdata.coh)
+    args = (data.replace(vis=None), cdata._replace(coh=None),
+            vis.real, vis.imag, coh.real, coh.imag,
+            np.asarray(p0), config, key)
+    if device is not None:
+        args = jax.device_put(args, device)
+    return _sagefit_packed_jit(*args)

@@ -1,0 +1,28 @@
+"""True-f32 matmul policy for solver entry points.
+
+TPU f32 matmuls default to single-pass bf16 MXU multiplication, which
+rounds the solver's linear algebra to ~3 significant digits — measured
+on the v5e to diverge warm-started calibration tiles at the noise
+floor where exact f32 reconverges (round 5, PERF.md "precision
+chapter"; the reference computes in f64, so true f32 is the floor for
+parity).  Every public solver entry traces under this context so any
+caller — fullbatch, ADMM mesh, federated, or a user jitting a solver
+directly — gets production precision on any backend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def true_f32(fn):
+    """Trace ``fn`` under HIGHEST matmul precision (see module doc)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.default_matmul_precision("highest"):
+            return fn(*args, **kwargs)
+
+    return wrapped

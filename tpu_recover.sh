@@ -29,16 +29,24 @@ fi
 # (a) bank the plain bench (persistent compile cache speeds retries)
 export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
 echo "=== banking plain TPU bench at $(date)" >> "$LOG"
-timeout 900 python bench.py > /root/repo/bench_tpu_r04.json 2>/root/repo/bench_tpu_r04.err
-if grep -q '"platform": "tpu"' /root/repo/bench_tpu_r04.json && \
-   grep -q '"north_star_shape": true' /root/repo/bench_tpu_r04.json; then
+timeout 900 python bench.py > /root/repo/bench_tpu_watch.json 2>/root/repo/bench_tpu_watch.err
+if grep -q '"platform": "tpu"' /root/repo/bench_tpu_watch.json && \
+   grep -q '"north_star_shape": true' /root/repo/bench_tpu_watch.json; then
   echo "BENCH BANKED (tpu, north-star) at $(date)" >> "$LOG"
 else
-  echo "BENCH NOT GREEN at $(date): $(cat /root/repo/bench_tpu_r04.json)" >> "$LOG"
+  echo "BENCH NOT GREEN at $(date): $(cat /root/repo/bench_tpu_watch.json)" >> "$LOG"
   exit 2
 fi
 
-# (b) staged kernel validation — stops at first hang, probes between steps
+# (b) round-5: the kernel ladder, fused bench, bf16 bench and the e2e
+# app are all hardware-validated and banked (bench_tpu_r05*.json,
+# PERF.md); on heal we only re-bank a fresh plain bench as liveness
+# evidence.  Do NOT chain compiles: the round-5 wedge came from a
+# fused-inside-EM compile (ROUND5_NOTES.md) and stacking compile
+# classes on a freshly healed relay risks re-wedging it.
+echo "bank-only mode: skipping kernel chain (round-5)" >> "$LOG"
+exit 0
+# (retained for reference) staged kernel validation:
 echo "=== staged kernel check at $(date)" >> "$LOG"
 /root/repo/tpu_kernel_check.sh > /root/repo/tpu_check.out 2>&1
 RC=$?
