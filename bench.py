@@ -97,8 +97,10 @@ V5E_BF16_PEAK_FLOPS = 197e12  # TPU v5e per-chip peak (bf16)
 # diverge: 1 = fused Pallas RIME kernel, 0 = XLA predict path.  Default
 # (env unset): fused on the TPU — hardware-validated round 5 at 40.6
 # it/s vs 14.8 for the XLA path — and XLA on the CPU fallback, where
-# interpret-mode Pallas would be orders slower.  main() resolves the
-# platform-dependent default before run() reads this global.
+# interpret-mode Pallas would be orders slower.  run() resolves the
+# platform-dependent default itself (from the device it actually runs
+# on), so importing bench and calling run() directly picks the same
+# path main() would.
 _FUSED_ENV = os.environ.get("SAGECAL_BENCH_FUSED")
 FUSED = bool(int(_FUSED_ENV)) if _FUSED_ENV is not None else False
 
@@ -310,6 +312,11 @@ def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ):
     # through the axon tunnel vs 74 ms for the whole predict once the
     # arrays are device-resident.  device_put once, time steady state.
     dev = jax.devices()[0]
+    # env unset -> platform-dependent default from the device this run
+    # actually targets (fused Pallas on TPU, XLA on CPU)
+    global FUSED
+    if _FUSED_ENV is None:
+        FUSED = dev.platform not in ("cpu",)
     if COH_BF16:
         import ml_dtypes
 
@@ -396,27 +403,28 @@ def main():
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-    if not _probe_default_backend():
+    probe_ok = _probe_default_backend()
+    if not probe_ok:
         sys.stderr.write(
             "bench: default (axon TPU) backend unavailable or wedged; "
             "falling back to CPU platform\n"
         )
         jax.config.update("jax_platforms", "cpu")
 
+    init_failed = False
     try:
         platform = jax.devices()[0].platform
     except RuntimeError:
+        init_failed = True
         jax.config.update("jax_platforms", "cpu")
         platform = jax.devices()[0].platform
 
     # north-star shape on the TPU; on the CPU-fallback path drop to the
     # small tilesz-5 shape (the full shape takes tens of minutes per
     # LBFGS solve on this single-core host) and compare against its own
-    # pinned baseline
+    # pinned baseline.  run() resolves the FUSED default from the
+    # device it targets.
     on_tpu = platform not in ("cpu",)
-    if _FUSED_ENV is None:
-        global FUSED
-        FUSED = on_tpu
     tilesz = TILESZ if on_tpu else 5
     repeats = REPEATS if on_tpu else 1
     value, iters, dt, xla_flops = run(
@@ -513,6 +521,22 @@ def main():
             * _REF_COST_EVALS_PER_ITER / our_evals_per_iter, 3
         ),
     }
+    # telemetry (SAGECAL_TELEMETRY=1): the bench outcome + any probe
+    # failure / CPU fallback land in the JSONL event log with a full
+    # RunManifest header
+    from sagecal_tpu.obs import RunManifest, default_event_log
+
+    elog = default_event_log(manifest=RunManifest.collect(
+        kernel_path="fused" if FUSED else "xla", app="bench",
+    ))
+    if elog is not None:
+        if not probe_ok:
+            elog.emit("tpu_probe_failed")
+        if not probe_ok or init_failed:
+            elog.emit("fallback_to_cpu", platform=platform,
+                      backend_init_failed=init_failed)
+        elog.emit("bench_result", **rec)
+        elog.close()
     print(json.dumps(rec))
 
 

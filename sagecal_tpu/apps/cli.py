@@ -5,7 +5,8 @@ Mode dispatch mirrors main.cpp:295-307: ``-N``>0 with ``-A``>0 and
 ``-w``>1 -> minibatch-consensus; ``-N``>0 -> minibatch; else fullbatch.
 The input is a vis.h5 dataset (convert an MS with
 ``python -m sagecal_tpu.apps.cli convert <ms> <h5>`` where casacore is
-available).
+available).  ``sagecal-tpu diag ...`` exposes the observability tooling
+(run manifests, JSONL event-log summaries, Prometheus export).
 """
 
 from __future__ import annotations
@@ -218,6 +219,12 @@ def _warn_dropped_fused(args, log=print):
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "diag":
+        # observability diagnostics: manifests, event-log summaries,
+        # Prometheus export (obs/diag.py)
+        from sagecal_tpu.obs.diag import main as diag_main
+
+        return diag_main(argv[1:])
     if argv and argv[0] == "convert":
         # convert <ms> <h5> [spw] — multi-SPW MSs convert one window
         # per .h5 band file (the reference expects pre-split MSs)

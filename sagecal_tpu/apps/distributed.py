@@ -276,13 +276,25 @@ def _run_distributed_inner(
             Z_diff0=Z_diff0, gamma=spatial_gamma, lam_diff=spatial_lam,
         )
 
+    # telemetry: per-band ADMM residual + rho traces ride along as extra
+    # mesh outputs when SAGECAL_TELEMETRY=1, and each tile's consensus
+    # run lands in the JSONL event log as one admm_round event
+    from sagecal_tpu.obs import RunManifest, default_event_log, telemetry_enabled
+
+    collect = telemetry_enabled()
     fn = make_admm_mesh_fn(
         mesh, nadmm=nadmm, max_emiter=cfg.max_emiter,
         plain_emiter=max(cfg.max_emiter, 2),
         lm_config=LMConfig(itmax=cfg.max_iter),
         bb_rho=adaptive_rho, solver_mode=cfg.solver_mode,
         spatial=spatial,
+        collect_trace=collect,
     )
+    elog = default_event_log(manifest=RunManifest.collect(
+        app="distributed", bands=Nf, nadmm=nadmm,
+        solver_mode=cfg.solver_mode, n_clusters=M, n_stations=N,
+        adaptive_rho=adaptive_rho,
+    ))
 
     # solution files: global Z + per-band J (slave :959-979 analog);
     # every handle is registered with the caller's finally-block
@@ -467,12 +479,32 @@ def _run_distributed_inner(
         traces.append(
             (np.asarray(out.dual_res), np.asarray(out.primal_res))
         )
+        if elog is not None:
+            # one event per tile = one consensus run of nadmm rounds;
+            # band-resolved residuals + the rho trajectory when the mesh
+            # fn was built with collect_trace
+            extra = {}
+            if out.primal_res_band is not None:
+                extra["primal_res_band"] = np.asarray(out.primal_res_band)
+                extra["dual_res_band"] = np.asarray(out.dual_res_band)
+                extra["rho_trace"] = np.asarray(out.rho_trace)
+            elog.emit(
+                "admm_round", tile=t0, nadmm=nadmm,
+                primal_res=np.asarray(out.primal_res),
+                dual_res=np.asarray(out.dual_res),
+                seconds=time.time() - tic,
+                phase_seconds=timer.tile_timings(), **extra,
+            )
         log(
             f"tile {t0}: dual {float(out.dual_res[-1]):.3e} primal "
             f"{float(out.primal_res[-1]):.3e} ({time.time()-tic:.1f}s) "
             f"[{timer.tile_summary()}]"
         )
       log(f"phases: {timer.run_summary()}")
+      if elog is not None:
+          elog.emit("run_done", n_tiles=len(traces),
+                    phase_totals=dict(timer.totals))
+          elog.close()
       # end-of-run spatial-model amplitude plot (the master's PPM
       # output, sagecal_master.cpp:1198 / pngoutput.c) from the final
       # tile's Zspat — shapelet basis only (the plot evaluates the

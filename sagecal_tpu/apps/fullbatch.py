@@ -158,13 +158,26 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
         p = jnp.broadcast_to(eye, (M, nchunk_max, 8 * N)).astype(dtype)
     pinit = p
 
+    # telemetry (obs/): per-iteration solver traces ride along as extra
+    # jitted outputs when SAGECAL_TELEMETRY=1; the JSONL event log gets
+    # the manifest now and per-tile events in the loop below
+    from sagecal_tpu.obs import RunManifest, default_event_log, telemetry_enabled
+    from sagecal_tpu.obs.records import sage_convergence_records
+
     scfg = SageConfig(
         max_emiter=cfg.max_emiter, max_iter=cfg.max_iter,
         max_lbfgs=cfg.max_lbfgs, lbfgs_m=cfg.lbfgs_m,
         solver_mode=cfg.solver_mode,
         nulow=cfg.nulow, nuhigh=cfg.nuhigh, randomize=cfg.randomize,
         use_fused_predict=cfg.use_fused_predict and not cfg.use_f64,
+        collect_telemetry=telemetry_enabled(),
     )
+    elog = default_event_log(manifest=RunManifest.collect(
+        kernel_path="fused" if scfg.use_fused_predict else "xla",
+        app="fullbatch", dataset=cfg.dataset, solver_mode=cfg.solver_mode,
+        tilesz=cfg.tilesz, n_clusters=M, n_stations=N,
+        simulation_mode=cfg.simulation_mode,
+    ))
 
     sol_fh = None
     if cfg.simulation_mode == 0:
@@ -275,6 +288,10 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
                 with timer.phase("load+coh"):
                     prepared = _prepare(pairs[pi + 1][1])
             ds.write_tile(t0, np.asarray(mat_of_flat(out_vis)), column="model")
+            if elog is not None:
+                elog.emit("tile_simulated", tile=t0,
+                          seconds=time.time() - tic,
+                          phase_seconds=timer.tile_timings())
             log(f"tile {t0}: simulated ({time.time()-tic:.1f}s)")
             continue
 
@@ -363,6 +380,15 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
                 )))
         with timer.phase("write"):
             ds.write_tile(t0, np.asarray(res), column=cfg.out_column)
+        if elog is not None:
+            for rec in sage_convergence_records(out.telemetry):
+                elog.emit("cluster_convergence", tile=t0, **rec)
+            elog.emit(
+                "tile_done", tile=t0, res0=res0, res1=res1,
+                mean_nu=float(out.mean_nu), diverged=bool(diverged),
+                seconds=time.time() - tic,
+                phase_seconds=timer.tile_timings(),
+            )
         log(
             f"tile {t0}: residual {res0:.6f} -> {res1:.6f} "
             f"nu {float(out.mean_nu):.1f} ({time.time()-tic:.1f}s) "
@@ -375,6 +401,10 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
         # solve/write raises mid-loop
         prefetch_cm.__exit__(None, None, None)
     log(timer.run_summary())
+    if elog is not None:
+        elog.emit("run_done", n_tiles=len(results),
+                  phase_totals=dict(timer.totals))
+        elog.close()
     stop_trace()
     if sol_fh:
         sol_fh.close()

@@ -113,6 +113,16 @@ def run_minibatch(cfg: RunConfig, log=print):
     if cfg.solver_mode in _ROBUST_MODES:
         robust_nu = 0.5 * (cfg.nulow + cfg.nuhigh)
 
+    # telemetry: per-minibatch progress + (consensus mode) per-ADMM-round
+    # band primal residuals land in the JSONL event log
+    from sagecal_tpu.obs import RunManifest, default_event_log
+
+    elog = default_event_log(manifest=RunManifest.collect(
+        app="minibatch", bands=len(bands), epochs=cfg.epochs,
+        minibatches=nb, consensus=consensus_mode,
+        solver_mode=cfg.solver_mode, n_clusters=M, n_stations=N,
+    ))
+
     def solve_band(bi, data_band, cdata_band):
         p1, mem1 = bfgsfit_minibatch(
             data_band, cdata_band, p_bands[bi],
@@ -175,17 +185,30 @@ def run_minibatch(cfg: RunConfig, log=print):
                             Y_bands[bi]
                             + rho[bi][:, None, None] * (p_bands[bi] - BZ1)
                         )
-                    if cfg.verbose:
-                        pres = float(sum(
-                            jnp.linalg.norm(
-                                (p_bands[bi]
-                                 - consensus.bz_for_freq(
-                                     Z, jnp.asarray(B[bi], dtype)
-                                 ).reshape(M, nchunk_max, 8 * N)).ravel()
-                            )
+                    if cfg.verbose or elog is not None:
+                        # per-band scaled primal residuals (the same
+                        # normalization the mesh driver logs,
+                        # consensus.admm_primal_residual)
+                        pres_band = [
+                            float(consensus.admm_primal_residual(
+                                p_bands[bi].ravel(),
+                                consensus.bz_for_freq(
+                                    Z, jnp.asarray(B[bi], dtype)
+                                ).ravel(),
+                            ))
                             for bi in range(len(bands))
-                        ))
-                        log(f"  admm {admm}: primal {pres:.4e}")
+                        ]
+                        if elog is not None:
+                            elog.emit(
+                                "admm_round", epoch=epoch, minibatch=mb,
+                                admm_iter=admm, primal_res=pres_band,
+                            )
+                        if cfg.verbose:
+                            log(f"  admm {admm}: primal "
+                                f"{sum(pres_band):.4e}")
+            if elog is not None:
+                elog.emit("minibatch_done", epoch=epoch, minibatch=mb,
+                          t0=t0, t1=t1, seconds=time.time() - tic)
             log(f"epoch {epoch} minibatch {mb}: "
                 f"({time.time()-tic:.1f}s)")
 
@@ -217,7 +240,12 @@ def run_minibatch(cfg: RunConfig, log=print):
     for bi in range(len(bands)):
         r0, r1 = float(np.sqrt(acc[bi][0])), float(np.sqrt(acc[bi][1]))
         results.append((r0, r1))
+        if elog is not None:
+            elog.emit("band_residual", band=bi, res0=r0, res1=r1)
         log(f"band {bi}: residual {r0:.4f} -> {r1:.4f}")
+    if elog is not None:
+        elog.emit("run_done", n_bands=len(bands))
+        elog.close()
 
     # write per-band solutions
     with open(cfg.out_solutions, "w") as fh:

@@ -1,0 +1,48 @@
+"""Structured telemetry: metrics, per-iteration traces, manifests, events.
+
+Four pieces (SURVEY section 5 "observability"):
+
+- :mod:`sagecal_tpu.obs.registry` — host-side counters/gauges/histograms
+  with Prometheus text export; a shared no-op registry when telemetry is
+  off so instrumented call sites never branch.
+- :mod:`sagecal_tpu.obs.records` — fixed-shape per-iteration solver
+  trace records (``IterTrace``) carried *through* jit/scan/while_loop as
+  auxiliary pytree outputs; host-callback-free by construction.
+- :mod:`sagecal_tpu.obs.events` — ``RunManifest`` + append-only JSONL
+  event log (``SAGECAL_TELEMETRY=1`` / ``SAGECAL_EVENT_LOG=...``).
+- :mod:`sagecal_tpu.obs.diag` — the ``sagecal-tpu diag`` CLI.
+
+This package root imports neither jax nor numpy, so ``from sagecal_tpu
+.obs import telemetry_enabled`` is safe anywhere, including before
+backend selection.
+"""
+
+from sagecal_tpu.obs.registry import (  # noqa: F401
+    MetricsRegistry,
+    NullRegistry,
+    get_registry,
+    set_telemetry,
+    telemetry,
+    telemetry_enabled,
+)
+from sagecal_tpu.obs.events import (  # noqa: F401
+    EventLog,
+    RunManifest,
+    default_event_log,
+    read_events,
+    validate_manifest,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "NullRegistry",
+    "get_registry",
+    "set_telemetry",
+    "telemetry",
+    "telemetry_enabled",
+    "EventLog",
+    "RunManifest",
+    "default_event_log",
+    "read_events",
+    "validate_manifest",
+]

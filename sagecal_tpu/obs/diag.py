@@ -1,0 +1,190 @@
+"""``sagecal-tpu diag`` — observability CLI.
+
+Subcommands:
+
+- ``manifest [--out FILE] [--kernel-path xla|fused]`` — collect a
+  :class:`~sagecal_tpu.obs.events.RunManifest` for THIS host/backend and
+  print (or write) it as JSON.  Exits non-zero only on I/O failure: a
+  broken accelerator backend is *recorded in the manifest*, not fatal.
+- ``validate FILE`` — check a manifest JSON (or the ``run_manifest``
+  event of a JSONL log) against the schema; exit 1 with a problem list
+  if invalid.
+- ``events FILE`` — summarize a JSONL event log: event counts by type,
+  run ids, time span, and solver-convergence / ADMM-residual digests.
+- ``prom [--events FILE]`` — dump the in-process metrics registry in
+  Prometheus text format (optionally re-ingesting phase timings from an
+  event log first, so a finished run can be exported after the fact).
+
+Runs standalone (``python -m sagecal_tpu.obs.diag ...``) or via the
+``diag`` subcommand of the main CLI (:mod:`sagecal_tpu.apps.cli`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from sagecal_tpu.obs.events import (
+    RunManifest,
+    read_events,
+    validate_manifest,
+)
+from sagecal_tpu.obs.registry import get_registry, telemetry
+
+
+def _cmd_manifest(args) -> int:
+    m = RunManifest.collect(kernel_path=args.kernel_path)
+    text = json.dumps(m.to_dict(), indent=2, sort_keys=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+        print(f"wrote manifest to {args.out}")
+    else:
+        print(text)
+    return 0
+
+
+def _load_manifest_dict(path: str) -> Optional[dict]:
+    with open(path, "r", encoding="utf-8") as f:
+        head = f.read()
+    try:
+        d = json.loads(head)
+        if isinstance(d, dict):
+            return d
+    except json.JSONDecodeError:
+        pass
+    # fall back: a JSONL event log — take its run_manifest event
+    for ev in read_events(path):
+        if ev.get("type") == "run_manifest":
+            return ev
+    return None
+
+
+def _cmd_validate(args) -> int:
+    d = _load_manifest_dict(args.file)
+    if d is None:
+        print(f"{args.file}: no manifest found", file=sys.stderr)
+        return 1
+    problems = validate_manifest(d)
+    if problems:
+        for p in problems:
+            print(f"{args.file}: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"{args.file}: valid manifest (run {d.get('run_id')}, "
+        f"{d.get('platform')}/{d.get('device_kind')} x{d.get('num_devices')}, "
+        f"kernel={d.get('kernel_path')})"
+    )
+    return 0
+
+
+def _finite(xs) -> List[float]:
+    out = []
+    for x in xs:
+        if isinstance(x, (int, float)) and x == x:
+            out.append(float(x))
+    return out
+
+
+def _cmd_events(args) -> int:
+    evs = read_events(args.file)
+    if not evs:
+        print(f"{args.file}: no events", file=sys.stderr)
+        return 1
+    by_type: dict = {}
+    for e in evs:
+        by_type[e.get("type", "?")] = by_type.get(e.get("type", "?"), 0) + 1
+    runs = sorted({e.get("run_id", "?") for e in evs})
+    ts = [e["ts"] for e in evs if isinstance(e.get("ts"), (int, float))]
+    span = (max(ts) - min(ts)) if ts else 0.0
+    print(f"{args.file}: {len(evs)} events, {len(runs)} run(s), "
+          f"{span:.1f}s span")
+    for t in sorted(by_type):
+        print(f"  {t}: {by_type[t]}")
+    # convergence digest: final cost per cluster record
+    conv = [e for e in evs if e.get("type") == "cluster_convergence"]
+    if conv:
+        finals = []
+        for e in conv:
+            costs = _finite(e.get("cost", []))
+            if costs:
+                finals.append(costs[-1])
+        if finals:
+            print(f"  convergence: {len(conv)} cluster records, "
+                  f"final cost min={min(finals):.4g} max={max(finals):.4g}")
+    admm = [e for e in evs if e.get("type") == "admm_round"]
+    if admm:
+        last = admm[-1]
+        pr = _finite(last.get("primal_res", []))
+        dr = _finite(last.get("dual_res", []))
+        if pr and dr:
+            print(f"  admm: {len(admm)} rounds, last primal_res "
+                  f"max={max(pr):.4g}, dual_res max={max(dr):.4g}")
+    tiles = [e for e in evs if e.get("type") == "tile_done"]
+    if tiles:
+        secs = _finite(sum(_finite((e.get("phase_seconds") or {}).values()))
+                       for e in tiles)
+        tot = sum(secs) if secs else 0.0
+        print(f"  tiles: {len(tiles)} done, {tot:.2f}s in phases")
+    return 0
+
+
+def _cmd_prom(args) -> int:
+    with telemetry(True):
+        reg = get_registry()
+        if args.events:
+            for e in read_events(args.events):
+                if e.get("type") == "tile_done":
+                    for phase, dt in (e.get("phase_seconds") or {}).items():
+                        if isinstance(dt, (int, float)):
+                            reg.observe("phase_seconds", float(dt),
+                                        phase=str(phase))
+                elif e.get("type") == "bench_result":
+                    thr = e.get("value")
+                    if isinstance(thr, (int, float)):
+                        reg.gauge_set(
+                            "bench_lbfgs_iters_per_second", float(thr),
+                            kernel="fused" if e.get("fused_kernel")
+                            else "xla",
+                        )
+        sys.stdout.write(reg.to_prometheus() or "# no metrics recorded\n")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="sagecal-tpu diag",
+        description="observability diagnostics (manifests, event logs, "
+                    "Prometheus export)",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    mp = sub.add_parser("manifest", help="collect + print a run manifest")
+    mp.add_argument("--out", default=None, help="write JSON here instead of stdout")
+    mp.add_argument("--kernel-path", default="xla", choices=("xla", "fused"))
+    mp.set_defaults(fn=_cmd_manifest)
+
+    vp = sub.add_parser("validate", help="validate a manifest JSON / event log")
+    vp.add_argument("file")
+    vp.set_defaults(fn=_cmd_validate)
+
+    ep = sub.add_parser("events", help="summarize a JSONL event log")
+    ep.add_argument("file")
+    ep.set_defaults(fn=_cmd_events)
+
+    pp = sub.add_parser("prom", help="Prometheus text dump of the registry")
+    pp.add_argument("--events", default=None,
+                    help="re-ingest phase timings from this event log first")
+    pp.set_defaults(fn=_cmd_prom)
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -513,6 +513,10 @@ def _chunk_plan(rowsp: int, tile: int, max_rows: int):
 
 
 def _map_row_chunks(one, n, chunk, F, rowsp):
+    assert n * chunk == rowsp, (
+        f"chunk plan must cover the row axis exactly: "
+        f"{n} * {chunk} != {rowsp}"
+    )
     out = jax.lax.map(one, jnp.arange(n))        # (n, F, 8, chunk)
     return out.transpose(1, 2, 0, 3).reshape(F, 8, rowsp)
 
@@ -531,8 +535,11 @@ def fused_predict_packed_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
     _, F, _, rowsp = coh_ri.shape
     plan = _chunk_plan(rowsp, tile, max_rows)
     if plan is None:
-        return fused_predict_packed(tab_re, tab_im, coh_ri, ant_p, ant_q,
-                                    tile)
+        # coherencies are constants of the solve on the chunked path
+        # too (stop_gradient inside one()); keep both paths identical
+        return fused_predict_packed(tab_re, tab_im,
+                                    jax.lax.stop_gradient(coh_ri),
+                                    ant_p, ant_q, tile)
     n, chunk = plan
 
     def one(i):
@@ -555,8 +562,9 @@ def fused_predict_packed_hybrid_chunked(tab_re, tab_im, coh_ri, ant_p,
     _, F, _, rowsp = coh_ri.shape
     plan = _chunk_plan(rowsp, tile, max_rows)
     if plan is None:
-        return fused_predict_packed_hybrid(tab_re, tab_im, coh_ri, ant_p,
-                                           ant_q, cmap, nc, tile)
+        return fused_predict_packed_hybrid(
+            tab_re, tab_im, jax.lax.stop_gradient(coh_ri), ant_p,
+            ant_q, cmap, nc, tile)
     n, chunk = plan
 
     def one(i):

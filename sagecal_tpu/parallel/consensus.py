@@ -158,3 +158,16 @@ def admm_dual_residual(Z_new, Z_old):
     (sagecal_master.cpp:878-885)."""
     d = (Z_new - Z_old).ravel()
     return jnp.linalg.norm(d) / jnp.sqrt(d.shape[0])
+
+
+def admm_primal_residual(J_flat, BZ_flat):
+    """Per-real-parameter primal residual ||J - BZ||/sqrt(size): how far
+    one band's local solution sits from its consensus target (the
+    per-slave primal norm of sagecal_master.cpp:869-876).  Pure array
+    math shared by the mesh ADMM's per-band residual telemetry
+    (parallel/mesh.py) and its reference tests."""
+    d = (J_flat - BZ_flat).reshape(J_flat.shape[0], -1) if J_flat.ndim > 1 \
+        else (J_flat - BZ_flat)[None]
+    n = jnp.sqrt(jnp.asarray(d.shape[-1], d.dtype))
+    out = jnp.sqrt(jnp.sum(d * d, axis=-1)) / n
+    return out if J_flat.ndim > 1 else out[0]

@@ -59,6 +59,7 @@ from sagecal_tpu.parallel.admm import admm_sagefit
 from sagecal_tpu.parallel.manifold import manifold_average
 from sagecal_tpu.solvers.lm import LMConfig
 from sagecal_tpu.solvers.sage import SM_LM_LBFGS, ClusterData
+from sagecal_tpu.utils.platform import shard_map as _shard_map
 
 
 class AdmmResult(NamedTuple):
@@ -71,6 +72,10 @@ class AdmmResult(NamedTuple):
     Zspat: Optional[jax.Array] = None  # (2*Npoly*N*nchunk?, 2G) spatial model
     spat_res: Optional[jax.Array] = None  # (nadmm,) ||Z - Zbar|| trace
     Zspat_diff: Optional[jax.Array] = None  # (D, 2G) diffuse-constraint model
+    # telemetry (collect_trace=True only; see sagecal_tpu.obs):
+    primal_res_band: Optional[jax.Array] = None  # (nadmm, Nf) per-band ||J-BZ||
+    dual_res_band: Optional[jax.Array] = None  # (nadmm, Nf) rho||B dZ|| per band
+    rho_trace: Optional[jax.Array] = None  # (nadmm, Nf, M) penalty trajectory
 
 
 class SpatialConfig(NamedTuple):
@@ -173,6 +178,7 @@ def make_admm_mesh_fn(
     solver_mode: int = SM_LM_LBFGS,
     robust_nu: Optional[float] = None,
     spatial: Optional[SpatialConfig] = None,
+    collect_trace: bool = False,
 ):
     """Build the jitted mesh-wide ADMM calibration function.
 
@@ -198,6 +204,13 @@ def make_admm_mesh_fn(
     multiplier X steps by ``alpha (Z - Zbar)``.  All spatial state is
     replicated across the mesh (it is master-side math in the
     reference — tiny compared to the sharded x-steps).
+
+    ``collect_trace``: statically enables ADMM telemetry — the result
+    additionally carries per-band primal/dual residual norms and the
+    full rho trajectory per iteration (``primal_res_band`` /
+    ``dual_res_band`` (nadmm, Nf), ``rho_trace`` (nadmm, Nf, M)); the
+    Barzilai-Borwein penalty adaptation is exactly what these exist to
+    monitor.  Off (default) the jitted signature is unchanged.
     """
 
     def _fit(data, cdata, p, Y, BZ, rho_m, emiter):
@@ -291,6 +304,18 @@ def make_admm_mesh_fn(
         BZ_all = jax.vmap(lambda g: bz_of(Z, g))(jnp.arange(G))
         Y = Yhat - rho[:, :, None, None] * BZ_all
 
+        def band_residuals(p_cur, Z_new, Z_old, rho_cur):
+            """Per-local-band primal ||J - BZ|| and dual rho||B dZ||
+            norms (both /sqrt(n), the scaling of the scalar pres)."""
+            BZn = jax.vmap(lambda g: bz_of(Z_new, g))(jnp.arange(G))
+            BZo = jax.vmap(lambda g: bz_of(Z_old, g))(jnp.arange(G))
+            pr = _flat(p_cur - BZn)  # (G, M, K)
+            rn = jnp.sqrt(jnp.asarray(pr[0].size, pr.dtype))
+            prn = jnp.sqrt(jnp.sum(pr * pr, axis=(1, 2))) / rn
+            dd = _flat(rho_cur[:, :, None, None] * (BZn - BZo))
+            ddn = jnp.sqrt(jnp.sum(dd * dd, axis=(1, 2))) / rn
+            return prn, ddn
+
         # ---- admm > 0: rotate over local slots -------------------------
         def one_iter(carry, it):
             p, Y, Z, rho, Yhat_all, Yhat_prev, p_prev, spstate = carry
@@ -356,9 +381,12 @@ def make_admm_mesh_fn(
             Yhat_prev1 = Yhat_prev.at[g].set(Yhat_g)
             p_prev1 = p_prev.at[g].set(p1_g)
             sres_out = spstate1[3] if use_spatial else jnp.zeros((), p0.dtype)
-            return (p1, Y1, Z1, rho1, Yhat_all1, Yhat_prev1, p_prev1, spstate1), (
-                dres, pres, sres_out,
-            )
+            ys = (dres, pres, sres_out)
+            if collect_trace:
+                prn, ddn = band_residuals(p1, Z1, Z, rho1)
+                ys = ys + (prn, ddn, rho1)
+            return (p1, Y1, Z1, rho1, Yhat_all1, Yhat_prev1, p_prev1,
+                    spstate1), ys
 
         spstate0 = (
             (Zbar_flat0, Xsp0, Zspat0, jnp.zeros((), p0.dtype),
@@ -368,9 +396,14 @@ def make_admm_mesh_fn(
             else jnp.zeros((), p0.dtype)
         )
         init = (p, Y, Z, rho, Yhat, Yhat, p, spstate0)
-        (p, Y, Z, rho, _, _, _, spstate), (dres, pres, sres) = jax.lax.scan(
-            one_iter, init, jnp.arange(1, nadmm)
-        )
+        if collect_trace:
+            # iteration-0 rows: residuals of the plain solve vs the first
+            # consensus (dual term is 0 by construction, dZ = 0)
+            prn0, _ = band_residuals(p, Z, Z, rho)
+            rho0 = rho
+        carry, ys = jax.lax.scan(one_iter, init, jnp.arange(1, nadmm))
+        (p, Y, Z, rho, _, _, _, spstate) = carry
+        (dres, pres, sres) = ys[:3]
         dres = jnp.concatenate([jnp.zeros((1,), dres.dtype), dres])
         pres = jnp.concatenate([jnp.zeros((1,), pres.dtype), pres])
         sres = jnp.concatenate([jnp.zeros((1,), sres.dtype), sres])
@@ -379,10 +412,23 @@ def make_admm_mesh_fn(
             spstate[4] if use_spatial and use_diff
             else jnp.zeros((1, 1), jnp.complex64)
         )
-        return p, Y, Z, rho, dres, pres, Zspat_out, sres, Zdiff_out
+        out = (p, Y, Z, rho, dres, pres, Zspat_out, sres, Zdiff_out)
+        if collect_trace:
+            prn_t, ddn_t, rho_t = ys[3:]
+            prn_t = jnp.concatenate([prn0[None], prn_t])
+            ddn_t = jnp.concatenate([jnp.zeros_like(prn0)[None], ddn_t])
+            rho_t = jnp.concatenate([rho0[None], rho_t])
+            out = out + (prn_t, ddn_t, rho_t)
+        return out
 
     fspec = P(axis_name)
     rspec = P()
+    out_specs = (fspec, fspec, rspec, fspec, rspec, rspec, rspec, rspec,
+                 rspec)
+    if collect_trace:
+        # band-axis telemetry shards on axis 1 (axis 0 is the iteration)
+        bspec = P(None, axis_name)
+        out_specs = out_specs + (bspec, bspec, bspec)
 
     ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
 
@@ -394,20 +440,22 @@ def make_admm_mesh_fn(
                 f"sub-band count {Nf} must be a multiple of the mesh size "
                 f"{ndev}; pad with zero-weight bands (rho=0, mask=0) first"
             )
-        sm = jax.shard_map(
+        sm = _shard_map(
             local_loop,
             mesh=mesh,
             in_specs=(fspec, fspec, fspec, fspec, fspec),
-            out_specs=(fspec, fspec, rspec, fspec, rspec, rspec, rspec,
-                       rspec, rspec),
+            out_specs=out_specs,
             check_vma=True,
         )
-        p, Y, Z, rho_f, dres, pres, Zspat, sres, Zdiff = sm(
-            data_stack, cdata_stack, p0, rho, B
-        )
+        out = sm(data_stack, cdata_stack, p0, rho, B)
+        p, Y, Z, rho_f, dres, pres, Zspat, sres, Zdiff = out[:9]
+        extra = {}
+        if collect_trace:
+            extra = dict(primal_res_band=out[9], dual_res_band=out[10],
+                         rho_trace=out[11])
         return AdmmResult(
             p=p, Y=Y, Z=Z, rho=rho_f, dual_res=dres, primal_res=pres,
-            Zspat=Zspat, spat_res=sres, Zspat_diff=Zdiff,
+            Zspat=Zspat, spat_res=sres, Zspat_diff=Zdiff, **extra,
         )
 
     return fn

@@ -119,7 +119,9 @@ def _armijo_bad(f_new, fold, alpha, product):
 
 def _armijo_rest(cost_fn, x, p, a0, fold, f_a0, product):
     """Armijo halving loop (lbfgs.c:444-475: at most 15 halvings) with
-    the first trial's cost ``f_a0`` already in hand."""
+    the first trial's cost ``f_a0`` already in hand.  Returns
+    ``(alpha, halvings)`` — the halving count feeds the telemetry
+    line-search evaluation counter."""
 
     def cond(st):
         ci, alpha, fnew = st
@@ -130,8 +132,8 @@ def _armijo_rest(cost_fn, x, p, a0, fold, f_a0, product):
         alpha = alpha * 0.5
         return ci + 1, alpha, cost_fn(x + alpha * p)
 
-    _, alpha, _ = jax.lax.while_loop(cond, body, (0, a0, f_a0))
-    return alpha
+    ci, alpha, _ = jax.lax.while_loop(cond, body, (0, a0, f_a0))
+    return alpha, ci
 
 
 class LBFGSResult(NamedTuple):
@@ -140,6 +142,9 @@ class LBFGSResult(NamedTuple):
     cost: jax.Array
     gradnorm: jax.Array
     iterations: jax.Array
+    # per-iteration IterTrace (obs.records) when collect_trace=True, else
+    # None — an empty pytree, so the jitted output signature is unchanged
+    trace: Optional[tuple] = None
 
 
 @true_f32
@@ -151,6 +156,7 @@ def lbfgs_fit(
     M: int = 7,
     memory: Optional[LBFGSMemory] = None,
     minibatch: bool = False,
+    collect_trace: bool = False,
 ) -> LBFGSResult:
     """Generic LBFGS fit (``lbfgs_fit``, Dirac.h:175 / lbfgs.c:479,717).
 
@@ -208,12 +214,16 @@ def lbfgs_fit(
         batch_changed = jnp.asarray(False)
         alphabar = jnp.asarray(1.0, p0.dtype)
 
+    from sagecal_tpu.obs.records import init_trace, write_trace
+
+    trace0 = init_trace(itmax, (), p0.dtype) if collect_trace else None
+
     def cond(state):
-        ck, x, f, g, gradnrm, mem, done = state
+        ck, x, f, g, gradnrm, mem, done, trace = state
         return (ck < itmax) & (~done)
 
     def body(state):
-        ck, x, f, g, gradnrm, mem, done = state
+        ck, x, f, g, gradnrm, mem, done, trace = state
         pk = _two_loop_direction(g, mem)
         # Evaluate value_and_grad AT the first Armijo trial point: when
         # the full step passes the sufficient-decrease test (the common
@@ -231,15 +241,16 @@ def lbfgs_fit(
         first_ok = ~_armijo_bad(f_t, f, a0, product)
 
         def accept_first(_):
-            return a0, f_t, g_t
+            return a0, f_t, g_t, jnp.ones((), x.dtype)
 
         def backtrack(_):
-            alpha = _armijo_rest(cost_fn, x, pk, a0, f, f_t, product)
+            alpha, halvings = _armijo_rest(cost_fn, x, pk, a0, f, f_t, product)
             fb, gb = vg_fn(x + alpha * pk)
-            return alpha, fb, gb
+            # first trial + each halving + the fused re-eval at alpha
+            return alpha, fb, gb, 2.0 + halvings.astype(x.dtype)
 
-        alphak, f1, g1 = jax.lax.cond(first_ok, accept_first, backtrack,
-                                      None)
+        alphak, f1, g1, ls_evals = jax.lax.cond(first_ok, accept_first,
+                                                backtrack, None)
         step_ok = jnp.isfinite(alphak) & (jnp.abs(alphak) >= CLM_EPSILON)
         x1 = x + alphak * pk
         gradnrm1 = jnp.linalg.norm(g1)
@@ -287,15 +298,24 @@ def lbfgs_fit(
         g_next = jnp.where(step_ok, g1, g)
         gradnrm_next = jnp.where(step_ok, gradnrm1, gradnrm)
         done_next = (~step_ok) | (~grad_ok)
-        return ck + 1, x_next, f_next, g_next, gradnrm_next, mem1, done_next
+        if trace is not None:
+            trace = write_trace(
+                trace, ck,
+                cost=f_next,
+                grad_norm=gradnrm_next,
+                step=alphak,
+                ls_evals=ls_evals,
+            )
+        return (ck + 1, x_next, f_next, g_next, gradnrm_next, mem1,
+                done_next, trace)
 
     from sagecal_tpu.utils.platform import match_vma
 
     start_done = ~(jnp.isfinite(gradnrm0) & (gradnrm0 > CLM_STOP_THRESH))
-    ck, x, f, g, gradnrm, mem, _ = jax.lax.while_loop(
+    ck, x, f, g, gradnrm, mem, _, trace = jax.lax.while_loop(
         cond, body,
         match_vma((jnp.asarray(0), p0, f0, g0, gradnrm0, memory,
-                   start_done), p0),
+                   start_done, trace0), p0),
     )
     return LBFGSResult(p=x, memory=mem, cost=f, gradnorm=gradnrm,
-                       iterations=ck)
+                       iterations=ck, trace=trace)

@@ -91,22 +91,35 @@ def robust_lm_solve(
     nuhigh: float = 30.0,
     em_iters: int = 3,
     config: LMConfig = LMConfig(),
+    collect_trace: bool = False,
 ):
     """Robust LM: EM over (weights, nu) wrapping weighted LM solves
     (``rlevmar_der_single_nocuda``, robustlm.c; Dirac.h:744).
 
-    Returns (LMResult, nu).
+    Returns (LMResult, nu).  With ``collect_trace`` the result's trace
+    stacks the EM stages in front: ``(em_iters + 1, itmax, nchunk)`` per
+    field (final weighted solve last), with the trace's ``nu`` field set
+    to the Student's-t nu in effect during each stage.
     """
     mask8 = mask[..., None, :]  # broadcasts over the (F, 8, rows) residual
 
     def em_step(carry, _):
         p, nu, sqrt_w = carry
         res = lm_solve(
-            vis, coh, mask, ant_p, ant_q, chunk_map, p, config, sqrt_weights=sqrt_w
+            vis, coh, mask, ant_p, ant_q, chunk_map, p, config,
+            sqrt_weights=sqrt_w, collect_trace=collect_trace,
         )
         ed = _residual_flat(res.p, coh, vis, mask, ant_p, ant_q, chunk_map, None)
         sqrt_w_new, nu_new = update_w_and_nu(ed, nu, nulow, nuhigh, mask=mask8)
-        return (res.p, nu_new, sqrt_w_new), res.cost
+        ys = res.cost
+        if collect_trace:
+            # nu in effect for this stage is the carried nu (it built the
+            # weights the solve just used)
+            tr = res.trace._replace(
+                nu=jnp.broadcast_to(nu, res.trace.nu.shape).astype(res.trace.nu.dtype)
+            )
+            ys = (res.cost, tr)
+        return (res.p, nu_new, sqrt_w_new), ys
 
     # E-step FIRST: weights from the residual at p0, so gross outliers are
     # suppressed before they can poison the first fit.  (The reference's
@@ -118,11 +131,22 @@ def robust_lm_solve(
         ed0, jnp.asarray(nu0, p0.dtype), nulow, nuhigh, mask=mask8
     )
     init = (p0, nu_e, sqrt_w0)
-    (p, nu, sqrt_w), costs = jax.lax.scan(em_step, init, None, length=em_iters)
+    (p, nu, sqrt_w), ys = jax.lax.scan(em_step, init, None, length=em_iters)
     # final weighted solve with converged weights
     res = lm_solve(
-        vis, coh, mask, ant_p, ant_q, chunk_map, p, config, sqrt_weights=sqrt_w
+        vis, coh, mask, ant_p, ant_q, chunk_map, p, config,
+        sqrt_weights=sqrt_w, collect_trace=collect_trace,
     )
+    if collect_trace:
+        _, em_traces = ys  # IterTrace stacked (em_iters, itmax, ...)
+        final_tr = res.trace._replace(
+            nu=jnp.broadcast_to(nu, res.trace.nu.shape).astype(res.trace.nu.dtype)
+        )
+        full = jax.tree_util.tree_map(
+            lambda em, fin: jnp.concatenate([em, fin[None]], axis=0),
+            em_traces, final_tr,
+        )
+        res = res._replace(trace=full)
     return res, nu
 
 

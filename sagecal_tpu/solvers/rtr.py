@@ -59,6 +59,9 @@ class RTRResult(NamedTuple):
     p: jax.Array  # (nchunk, 8N)
     cost0: jax.Array  # (nchunk,)
     cost: jax.Array  # (nchunk,)
+    # per-iteration IterTrace (obs.records) when collect_trace=True, else
+    # None — an empty pytree, so the jitted output signature is unchanged
+    trace: Optional[tuple] = None
 
 
 # ---------------------------------------------------------------------------
@@ -258,7 +261,7 @@ def _tcg(x, grad, Delta, hess, cfg: RTRConfig):
     from sagecal_tpu.utils.platform import match_vma
 
     out = jax.lax.while_loop(cond, body, match_vma(state, grad))
-    return out["eta"], out["Heta"]
+    return out["eta"], out["Heta"], out["j"]
 
 
 # ---------------------------------------------------------------------------
@@ -267,7 +270,7 @@ def _tcg(x, grad, Delta, hess, cfg: RTRConfig):
 
 def _rtr_single(
     vis, coh, rowmask, ant_p, ant_q, x0, cfg: RTRConfig, sqrt_w, itmax_dyn=None,
-    admm=None,
+    admm=None, collect_trace: bool = False,
 ):
     """``itmax_dyn``: optional traced base iteration budget; the RSD/TR
     bounds become min(static, dyn+5)/min(static, dyn+10), matching the
@@ -321,13 +324,17 @@ def _rtr_single(
     Delta0 = Delta_bar * 0.125
     rho_reg0 = fx * 1e-6
 
+    from sagecal_tpu.obs.records import init_trace, write_trace
+
+    trace0 = init_trace(cfg.itmax_rtr, (), fx.real.dtype) if collect_trace else None
+
     def tr_cond(s):
         return (s["k"] < rtr_bound) & (~s["stop"])
 
     def tr_body(s):
         x, fx, Delta = s["x"], s["fx"], s["Delta"]
         g = grad_fn(x, iw)
-        eta, Heta = _tcg(x, g, Delta, hess, cfg)
+        eta, Heta, cg_j = _tcg(x, g, Delta, hess, cfg)
         x_prop = x + eta  # fns_R: additive retraction
         fx_prop = cost_c(x_prop)
         rhonum = fx - fx_prop
@@ -350,29 +357,38 @@ def _rtr_single(
         x1 = jnp.where(accept, x_prop, x)
         fx1 = jnp.where(accept, fx_prop, fx)
         gnorm = jnp.sqrt(_g(g, g))
-        return dict(
+        st = dict(
             k=s["k"] + 1, x=x1, fx=fx1, Delta=Delta_new,
             stop=gnorm < cfg.epsilon,
         )
+        if collect_trace:
+            # ls_evals records the inner truncated-CG iteration count —
+            # the TR analog of line-search cost evaluations
+            st["trace"] = write_trace(
+                s["trace"], s["k"],
+                cost=fx1,
+                grad_norm=gnorm,
+                step=jnp.sqrt(jnp.maximum(_g(eta, eta), 0.0)),
+                ls_evals=cg_j.astype(fx1.dtype),
+            )
+        return st
 
     from sagecal_tpu.utils.platform import match_vma
 
-    out = jax.lax.while_loop(
-        tr_cond, tr_body,
-        match_vma(
-            dict(k=jnp.asarray(0), x=x, fx=fx, Delta=Delta0,
-                 stop=jnp.asarray(False)),
-            x,
-        ),
-    )
+    state0 = dict(k=jnp.asarray(0), x=x, fx=fx, Delta=Delta0,
+                  stop=jnp.asarray(False))
+    if collect_trace:
+        state0["trace"] = trace0
+    out = jax.lax.while_loop(tr_cond, tr_body, match_vma(state0, x))
     # guard: never return something worse than the input
     better = out["fx"] <= fx0
     xf = jnp.where(better, out["x"], x0)
-    return xf, fx0, jnp.where(better, out["fx"], fx0)
+    return xf, fx0, jnp.where(better, out["fx"], fx0), out.get("trace")
 
 
 def _nsd_single(
-    vis, coh, rowmask, ant_p, ant_q, x0, itmax, sqrt_w, itmax_dyn=None, admm=None
+    vis, coh, rowmask, ant_p, ant_q, x0, itmax, sqrt_w, itmax_dyn=None,
+    admm=None, collect_trace: bool = False,
 ):
     """Nesterov accelerated manifold descent
     (nsd_solve_nocuda_robust, rtr_solve_robust.c:1878-2090).
@@ -400,6 +416,7 @@ def _nsd_single(
     def body(carry, i):
         x, z, g, t, theta, done = carry
         done = done | (i >= bound)
+        active = ~done
         x_prop = x
         z_prop = z
         x1 = z - t * g
@@ -421,14 +438,21 @@ def _nsd_single(
         t1 = jnp.minimum(1.01 * t, jnp.maximum(0.5 * t, t_hat))
         done2 = done1 | bad
         keep = lambda a, b: jnp.where(done2, a, b)
-        return (
+        carry1 = (
             keep(x, x1), keep(z, z1), keep(g, g1), keep(t, t1),
             keep(theta, theta1), done2,
-        ), None
+        )
+        if not collect_trace:
+            return carry1, None
+        # per-iteration telemetry costs one extra cost eval per step —
+        # paid only in collect_trace builds (static gate)
+        nanv = jnp.asarray(jnp.nan, t.dtype)
+        mark = lambda v: jnp.where(active, v, nanv)
+        return carry1, (mark(cost_c(carry1[0])), mark(gn), mark(t))
 
     from sagecal_tpu.utils.platform import match_vma
 
-    (x, _, _, _, _, _), _ = jax.lax.scan(
+    (x, _, _, _, _, _), ys = jax.lax.scan(
         body,
         match_vma(
             (x0, x0, g0, t0, jnp.asarray(1.0, t0.dtype), jnp.asarray(False)),
@@ -436,9 +460,20 @@ def _nsd_single(
         ),
         jnp.arange(itmax),
     )
+    if collect_trace:
+        from sagecal_tpu.obs.records import IterTrace
+
+        costs, gns, ts = ys
+        trace = IterTrace(
+            cost=costs, grad_norm=gns, step=ts,
+            ls_evals=jnp.zeros_like(costs),
+            nu=jnp.full((itmax,), jnp.nan, costs.dtype),
+        )
+    else:
+        trace = None
     fx = cost_c(x)
     better = fx <= fx0
-    return jnp.where(better, x, x0), fx0, jnp.where(better, fx, fx0)
+    return jnp.where(better, x, x0), fx0, jnp.where(better, fx, fx0), trace
 
 
 # ---------------------------------------------------------------------------
@@ -469,7 +504,7 @@ def _chunked(solver):
                     admm=(y_c, bz_c, r_c), **kwargs,
                 )
 
-            xf, c0, c1 = jax.vmap(lane)(jnp.arange(nchunk), x0, Yc, BZc, rho)
+            xf, c0, c1, tr = jax.vmap(lane)(jnp.arange(nchunk), x0, Yc, BZc, rho)
         else:
 
             def lane(c, x0_c):
@@ -478,8 +513,12 @@ def _chunked(solver):
                     vis, coh, rowmask, ant_p, ant_q, x0_c, *args, **kwargs
                 )
 
-            xf, c0, c1 = jax.vmap(lane)(jnp.arange(nchunk), x0)
-        return RTRResult(p=jones_to_params(xf), cost0=c0, cost=c1)
+            xf, c0, c1, tr = jax.vmap(lane)(jnp.arange(nchunk), x0)
+        if tr is not None:
+            # vmapped per-lane traces are (nchunk, itmax); present them
+            # iteration-major like the LM trace: (itmax, nchunk)
+            tr = jax.tree_util.tree_map(lambda a: jnp.moveaxis(a, 0, 1), tr)
+        return RTRResult(p=jones_to_params(xf), cost0=c0, cost=c1, trace=tr)
 
     return run
 
@@ -491,6 +530,7 @@ def rtr_solve(
     sqrt_weights: Optional[jax.Array] = None,
     itmax_dynamic=None,
     admm_y=None, admm_bz=None, admm_rho=None,
+    collect_trace: bool = False,
 ) -> RTRResult:
     """Batched-over-chunks RTR solve (``rtr_solve_nocuda``, Dirac.h:1132).
 
@@ -505,6 +545,7 @@ def rtr_solve(
     return _chunked(_rtr_single)(
         vis, coh, mask, ant_p, ant_q, chunk_map, p0, config, sqrt_weights,
         itmax_dynamic, admm_y=admm_y, admm_bz=admm_bz, admm_rho=admm_rho,
+        collect_trace=collect_trace,
     )
 
 
@@ -515,6 +556,7 @@ def nsd_solve(
     sqrt_weights: Optional[jax.Array] = None,
     itmax_dynamic=None,
     admm_y=None, admm_bz=None, admm_rho=None,
+    collect_trace: bool = False,
 ) -> RTRResult:
     """Batched Nesterov steepest descent (``nsd_solve_nocuda_robust``,
     Dirac.h:1166); ADMM-augmented when ``admm_y/admm_bz/admm_rho`` given
@@ -522,6 +564,7 @@ def nsd_solve(
     return _chunked(_nsd_single)(
         vis, coh, mask, ant_p, ant_q, chunk_map, p0, itmax, sqrt_weights,
         itmax_dynamic, admm_y=admm_y, admm_bz=admm_bz, admm_rho=admm_rho,
+        collect_trace=collect_trace,
     )
 
 
@@ -558,6 +601,7 @@ def rtr_solve_robust(
     em_iters: int = 2,
     itmax_dynamic=None,
     admm_y=None, admm_bz=None, admm_rho=None,
+    collect_trace: bool = False,
 ):
     """Student's-t EM wrapping RTR (``rtr_solve_nocuda_robust``,
     Dirac.h:1145): E-step per-baseline weights (see
@@ -577,21 +621,31 @@ def rtr_solve_robust(
             vis, coh, mask, ant_p, ant_q, chunk_map, p, config,
             sqrt_weights=sqrt_w, itmax_dynamic=itmax_dynamic,
             admm_y=admm_y, admm_bz=admm_bz, admm_rho=admm_rho,
+            collect_trace=collect_trace,
         )
-        return (out.p, nu1), (out.cost0, out.cost)
+        ys = (out.cost0, out.cost)
+        if collect_trace:
+            tr = out.trace._replace(
+                nu=jnp.broadcast_to(nu1, out.trace.nu.shape).astype(
+                    out.trace.nu.dtype)
+            )
+            ys = ys + (tr,)
+        return (out.p, nu1), ys
 
     from sagecal_tpu.utils.platform import match_vma
 
-    (p, nu), (c0s, c1s) = jax.lax.scan(
+    (p, nu), ys = jax.lax.scan(
         em, match_vma((p0, jnp.asarray(nu0, p0.dtype)), p0), None,
         length=em_iters
     )
+    c0s, c1s = ys[0], ys[1]
+    trace = ys[2] if collect_trace else None  # (em_iters, itmax, nchunk)
     # re-estimate nu from the FINAL solution (the reference updates the
     # weights/nu once more after the loop, rtr_solve_robust.c:1625)
     _, nu = _robust_weights_and_nu(
         vis, coh, mask, ant_p, ant_q, chunk_map, p, nu, nulow, nuhigh
     )
-    return RTRResult(p=p, cost0=c0s[0], cost=c1s[-1]), nu
+    return RTRResult(p=p, cost0=c0s[0], cost=c1s[-1], trace=trace), nu
 
 
 @true_f32
@@ -602,6 +656,7 @@ def nsd_solve_robust(
     em_iters: int = 2,
     itmax_dynamic=None,
     admm_y=None, admm_bz=None, admm_rho=None,
+    collect_trace: bool = False,
 ):
     """Robust Nesterov descent (``nsd_solve_nocuda_robust``,
     rtr_solve_robust.c:1878): the same Student's-t EM around
@@ -619,17 +674,27 @@ def nsd_solve_robust(
             vis, coh, mask, ant_p, ant_q, chunk_map, p, itmax,
             sqrt_weights=sqrt_w, itmax_dynamic=itmax_dynamic,
             admm_y=admm_y, admm_bz=admm_bz, admm_rho=admm_rho,
+            collect_trace=collect_trace,
         )
-        return (out.p, nu1), (out.cost0, out.cost)
+        ys = (out.cost0, out.cost)
+        if collect_trace:
+            tr = out.trace._replace(
+                nu=jnp.broadcast_to(nu1, out.trace.nu.shape).astype(
+                    out.trace.nu.dtype)
+            )
+            ys = ys + (tr,)
+        return (out.p, nu1), ys
 
     from sagecal_tpu.utils.platform import match_vma
 
-    (p, nu), (c0s, c1s) = jax.lax.scan(
+    (p, nu), ys = jax.lax.scan(
         em, match_vma((p0, jnp.asarray(nu0, p0.dtype)), p0), None,
         length=em_iters
     )
+    c0s, c1s = ys[0], ys[1]
+    trace = ys[2] if collect_trace else None  # (em_iters, itmax, nchunk)
     # final-solution nu re-estimate (rtr_solve_robust.c:2104)
     _, nu = _robust_weights_and_nu(
         vis, coh, mask, ant_p, ant_q, chunk_map, p, nu, nulow, nuhigh
     )
-    return RTRResult(p=p, cost0=c0s[0], cost=c1s[-1]), nu
+    return RTRResult(p=p, cost0=c0s[0], cost=c1s[-1], trace=trace), nu

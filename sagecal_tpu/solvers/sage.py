@@ -91,6 +91,11 @@ class SageConfig:
     # intentional compile-time/runtime tradeoff: raise it if profiling
     # shows clusters exhausting their dynamic budget.
     iter_budget_cap: int = struct.field(pytree_node=False, default=3)
+    # Collect per-iteration solver telemetry (obs.records.IterTrace) from
+    # every per-cluster solve and the joint LBFGS, returned in
+    # SageResult.telemetry.  Static: off builds the exact same jaxpr as
+    # before (telemetry slots are None = empty pytrees).
+    collect_telemetry: bool = struct.field(pytree_node=False, default=False)
 
 
 class ClusterData(NamedTuple):
@@ -114,6 +119,11 @@ class SageResult(NamedTuple):
     res_1: jax.Array  # final residual norm / n
     mean_nu: jax.Array
     diverged: jax.Array  # bool, res_1 > res_0 (the reference's -1 return)
+    # {"em": tuple of per-EM-pass IterTrace pytrees (leading cluster
+    # axis), "lbfgs": joint-LBFGS IterTrace} when
+    # config.collect_telemetry, else None (empty pytree — jitted output
+    # signature unchanged)
+    telemetry: Optional[dict] = None
 
 
 def build_cluster_data(
@@ -501,6 +511,12 @@ def sagefit(
         c1 = jnp.sum(res.cost)
         return jnp.where(c0 > 0.0, jnp.maximum((c0 - c1) / c0, 0.0), 0.0)
 
+    collect = config.collect_telemetry
+
+    def _aux_of(res, nu_k):
+        aux = (_nerr_of(res), nu_k)
+        return aux + (res.trace,) if collect else aux
+
     def em_iteration(p_all, nerr, nus_in, weighted, em_idx, key):
         """One EM pass over clusters via :func:`em_residual_scan`."""
         last_em = em_idx == config.max_emiter - 1
@@ -535,8 +551,9 @@ def sagefit(
                     RTRConfig(itmax_rsd=iter_cap + 5,
                               itmax_rtr=iter_cap + 10),
                     itmax_dynamic=itermax,
+                    collect_trace=collect,
                 )
-                return res.p, (_nerr_of(res), jnp.asarray(config.nulow, p_all.dtype))
+                return res.p, _aux_of(res, jnp.asarray(config.nulow, p_all.dtype))
             if mode == SM_RTR_OSRLM_RLBFGS:
                 # nu carried across EM passes (lmfit.c:940-947 sets
                 # robust_nu only at ci==0 and lets it persist)
@@ -549,8 +566,9 @@ def sagefit(
                     nu0=nu_prev, nulow=config.nulow, nuhigh=config.nuhigh,
                     em_iters=config.em_rounds_robust,
                     itmax_dynamic=itermax,
+                    collect_trace=collect,
                 )
-                return res.p, (_nerr_of(res), nu_k.astype(p_all.dtype))
+                return res.p, _aux_of(res, nu_k.astype(p_all.dtype))
             if mode == SM_NSD_RLBFGS:
                 # robust NSD with nu estimation (rtr_solve_robust.c:2104)
                 from sagecal_tpu.solvers.rtr import nsd_solve_robust
@@ -561,42 +579,49 @@ def sagefit(
                     nu0=nu_prev, nulow=config.nulow, nuhigh=config.nuhigh,
                     em_iters=config.em_rounds_robust,
                     itmax_dynamic=itermax,
+                    collect_trace=collect,
                 )
-                return res.p, (_nerr_of(res), nu_k.astype(p_all.dtype))
+                return res.p, _aux_of(res, nu_k.astype(p_all.dtype))
             if use_robust:
                 res, nu_k = robust_lm_solve(
                     xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
                     nu0=config.nulow, nulow=config.nulow, nuhigh=config.nuhigh,
                     em_iters=config.em_rounds_robust,
                     config=LMConfig(itmax=config.max_iter),
+                    collect_trace=collect,
                 )
             elif use_os:
                 res = os_lm_solve(
                     xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
-                    lmcfg, nsubsets=2, key=key_k,
+                    lmcfg, nsubsets=2, key=key_k, collect_trace=collect,
                 )
                 nu_k = jnp.asarray(config.nulow, p_all.dtype)
             else:
                 res = lm_solve(
                     xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
-                    lmcfg, itmax_dynamic=itermax,
+                    lmcfg, itmax_dynamic=itermax, collect_trace=collect,
                 )
                 nu_k = jnp.asarray(config.nulow, p_all.dtype)
-            return res.p, (_nerr_of(res), nu_k)
+            return res.p, _aux_of(res, nu_k)
 
-        p_new, (nerr_new, nus) = em_residual_scan(
+        p_new, aux = em_residual_scan(
             data, cdata, p_all, (nerr, subkeys, nus_in), solve_one
         )
+        nerr_new, nus = aux[0], aux[1]
+        tr = aux[2] if collect else None  # IterTrace, leading cluster axis
         total = jnp.sum(nerr_new)
         nerr_norm = jnp.where(total > 0.0, nerr_new / total, nerr_new)
-        return p_new, nerr_norm, nus, key
+        return p_new, nerr_norm, nus, key, tr
 
     p = p0
     nerr = jnp.zeros((M,), p0.dtype)
     weighted = jnp.asarray(False)
     nus = jnp.full((M,), config.nulow, p0.dtype)
+    em_traces = []
     for em in range(config.max_emiter):
-        p, nerr, nus, key = em_iteration(p, nerr, nus, weighted, em, key)
+        p, nerr, nus, key, tr = em_iteration(p, nerr, nus, weighted, em, key)
+        if collect:
+            em_traces.append(tr)
         if config.randomize:
             weighted = ~weighted
     mean_nu = jnp.clip(jnp.mean(nus), config.nulow, config.nuhigh)
@@ -629,16 +654,25 @@ def sagefit(
                 itmax=config.max_lbfgs, M=config.lbfgs_m,
             )
             p = fitb.p.reshape(M, nchunk_max, n8)
+            lbfgs_trace = None  # bounded path not instrumented
         else:
             fit = lbfgs_fit(
-                cost_fn, None, pflat0, itmax=config.max_lbfgs, M=config.lbfgs_m
+                cost_fn, None, pflat0, itmax=config.max_lbfgs,
+                M=config.lbfgs_m, collect_trace=collect,
             )
             p = fit.p.reshape(M, nchunk_max, n8)
+            lbfgs_trace = fit.trace
+    else:
+        lbfgs_trace = None
 
     full1 = predict_full_model(p, cdata, data)
     res_1 = _res_norm(data.vis - full1, data.mask, nreal)
+    telemetry = (
+        {"em": tuple(em_traces), "lbfgs": lbfgs_trace} if collect else None
+    )
     return SageResult(
-        p=p, res_0=res_0, res_1=res_1, mean_nu=mean_nu, diverged=res_1 > res_0
+        p=p, res_0=res_0, res_1=res_1, mean_nu=mean_nu,
+        diverged=res_1 > res_0, telemetry=telemetry,
     )
 
 

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from sagecal_tpu.utils.platform import shard_map
 
 from sagecal_tpu.core.types import VisData
 from sagecal_tpu.solvers.lbfgs import lbfgs_fit

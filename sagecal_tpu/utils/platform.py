@@ -11,6 +11,8 @@ are shared by the driver entry points (``__graft_entry__.py``,
 
 from __future__ import annotations
 
+import functools
+import glob
 import os
 import re
 import subprocess
@@ -22,12 +24,71 @@ _COUNT_FLAG = "--xla_force_host_platform_device_count"
 # legitimately arrive at a collective minutes apart (e.g. a heavy robust
 # RTR x-step on a single-core host); XLA CPU's default collective
 # rendezvous terminates the process after ~40 s.  Raise the limits
-# whenever we force the virtual-device mesh.
+# whenever we force the virtual-device mesh — but only the limits this
+# jaxlib actually knows: XLA fatal-aborts the whole process on unknown
+# XLA_FLAGS (parse_flags_from_env.cc), so every flag must be vetted
+# against the installed binary before backend init.
 _RENDEZVOUS_FLAGS = (
     "--xla_cpu_collective_timeout_seconds=7200",
     "--xla_cpu_collective_call_warn_stuck_timeout_seconds=600",
     "--xla_cpu_collective_call_terminate_timeout_seconds=7200",
 )
+
+
+@functools.lru_cache(maxsize=1)
+def _xla_extension_paths() -> tuple:
+    try:
+        import jaxlib
+
+        root = os.path.dirname(jaxlib.__file__)
+    except Exception:
+        return ()
+    paths = [
+        p
+        for p in glob.glob(os.path.join(root, "**", "xla_extension*.so*"),
+                           recursive=True)
+        if "\0" not in p and os.path.isfile(p)
+    ]
+    return tuple(sorted(paths))
+
+
+@functools.lru_cache(maxsize=None)
+def _binary_knows_flags(names: tuple) -> frozenset:
+    """Subset of flag `names` present as literal strings in the installed
+    xla_extension binary (where XLA's flag registry keeps them)."""
+    needles = {n: n.encode() for n in names}
+    found = set()
+    overlap = max((len(b) for b in needles.values()), default=1)
+    for path in _xla_extension_paths():
+        try:
+            with open(path, "rb") as f:
+                tail = b""
+                while len(found) < len(needles):
+                    buf = f.read(1 << 24)
+                    if not buf:
+                        break
+                    hay = tail + buf
+                    for n, b in needles.items():
+                        if n not in found and b in hay:
+                            found.add(n)
+                    tail = hay[-overlap:]
+        except OSError:
+            continue
+        if len(found) == len(needles):
+            break
+    return frozenset(found)
+
+
+def supported_xla_flags(flags) -> tuple:
+    """Filter ``--name=value`` XLA flags down to those the installed
+    jaxlib recognises.  Unknown names are dropped (passing one aborts the
+    process); if the binary cannot be located nothing is vouched for and
+    the result is empty."""
+    names = tuple(f.split("=")[0].lstrip("-") for f in flags)
+    known = _binary_knows_flags(names)
+    return tuple(
+        f for f, n in zip(flags, names) if n in known
+    )
 
 
 def probe_default_backend(timeout: float = 240.0) -> bool:
@@ -79,7 +140,7 @@ def ensure_cpu_devices(n_devices: int) -> None:
             flags + f" {_COUNT_FLAG}={n_devices}"
         ).strip()
     flags = os.environ["XLA_FLAGS"]
-    for f in _RENDEZVOUS_FLAGS:
+    for f in supported_xla_flags(_RENDEZVOUS_FLAGS):
         if f.split("=")[0] not in flags:
             flags = flags + " " + f
     os.environ["XLA_FLAGS"] = flags.strip()
@@ -109,6 +170,29 @@ def ensure_cpu_devices(n_devices: int) -> None:
                 f"could not create {n_devices} virtual CPU devices "
                 f"(got {_count()}); XLA_FLAGS={os.environ.get('XLA_FLAGS')}"
             )
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable ``shard_map``: newer jax exposes it as
+    ``jax.shard_map`` with varying-manual-axes checking (``check_vma``);
+    jax 0.4.x only has ``jax.experimental.shard_map`` with the older
+    replication checker, which rejects valid constant-initialized loop
+    carries (the very thing :func:`match_vma` papers over on new jax —
+    and ``lax.pcast`` does not exist on 0.4.x), so there the check is
+    disabled."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
 
 
 def match_vma(tree, ref):

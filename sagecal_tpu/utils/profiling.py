@@ -7,7 +7,12 @@ feature of the rebuild.  Two layers:
 
 - :class:`PhaseTimer` — cheap always-on wall-clock accounting per named
   phase (load / coherencies / solve / residual / write), printed as one
-  summary line per tile and totals at the end of a run.
+  summary line per tile and totals at the end of a run.  When telemetry
+  is enabled (``SAGECAL_TELEMETRY=1``) every phase duration is also
+  observed into the ``phase_seconds`` histogram of the process-wide
+  :func:`sagecal_tpu.obs.registry.get_registry`, so ``sagecal-tpu diag
+  prom`` exports the same numbers Prometheus-style; :meth:`PhaseTimer.
+  tile_timings` hands the per-tile window to the JSONL event log.
 - XLA device traces — set ``SAGECAL_PROFILE_DIR=/some/dir`` (or call
   :func:`start_trace` yourself) to capture a TensorBoard-loadable
   ``jax.profiler`` trace of the same run; phases are annotated with
@@ -67,6 +72,17 @@ class PhaseTimer:
         self.totals[name] += dt
         self.counts[name] += 1
         self._tile[name] = self._tile.get(name, 0.0) + dt
+        from sagecal_tpu.obs.registry import get_registry
+
+        get_registry().observe(
+            "phase_seconds", dt,
+            help="wall-clock seconds per named pipeline phase", phase=name,
+        )
+
+    def tile_timings(self) -> Dict[str, float]:
+        """Snapshot of the current per-tile window (does not reset) —
+        the per-tile payload for the JSONL event log."""
+        return dict(self._tile)
 
     def tile_summary(self) -> str:
         """One-line per-tile breakdown; resets the per-tile window."""

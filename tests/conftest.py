@@ -14,12 +14,16 @@ if "xla_force_host_platform_device_count" not in flags:
 # 8 virtual devices time-share this host's core(s): shards reach
 # collectives far apart in wall-clock, and XLA CPU's rendezvous would
 # abort the process after ~40 s (observed with the robust-RTR ADMM
-# x-step).  Raise the limits for the whole suite.
-for f in (
+# x-step).  Raise the limits for the whole suite — but only with flags
+# this jaxlib build actually recognises: XLA fatal-aborts the whole test
+# process on any unknown name in XLA_FLAGS.
+from sagecal_tpu.utils.platform import supported_xla_flags  # noqa: E402
+
+for f in supported_xla_flags((
     "--xla_cpu_collective_timeout_seconds=7200",
     "--xla_cpu_collective_call_warn_stuck_timeout_seconds=600",
     "--xla_cpu_collective_call_terminate_timeout_seconds=7200",
-):
+)):
     if f.split("=")[0] not in flags:
         flags = flags + " " + f
 os.environ["XLA_FLAGS"] = flags.strip()

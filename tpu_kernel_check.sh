@@ -7,6 +7,16 @@ cd /root/repo
 probe() {
   timeout 75 python -c "import jax; print(jax.devices())" 2>/dev/null | grep -q TPU
 }
+MANIFEST_DIR=/root/repo/.telemetry
+mkdir -p "$MANIFEST_DIR"
+manifest() {  # manifest <step-name>: record + validate what ran where
+  local name=$1 out="$MANIFEST_DIR/manifest-$name.json"
+  timeout 120 python -m sagecal_tpu.obs.diag manifest \
+    --kernel-path fused --out "$out" >/dev/null 2>&1
+  if ! timeout 60 python -m sagecal_tpu.obs.diag validate "$out"; then
+    echo "$name: INVALID RUN MANIFEST - stop"; exit 1
+  fi
+}
 step() {  # step <name> <timeout> <cmd...>
   local name=$1 to=$2; shift 2
   echo "=== $name"
@@ -14,6 +24,7 @@ step() {  # step <name> <timeout> <cmd...>
   timeout "$to" "$@" 2>&1 | grep -v WARNING | tail -4
   local rc=${PIPESTATUS[0]}
   if [ "$rc" != 0 ]; then echo "$name FAILED rc=$rc - stop"; exit 1; fi
+  manifest "$name"
 }
 export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
 step bisect-c 200 python kbisect.py c
@@ -25,6 +36,18 @@ step kernel-bwd-small 300 python kbisect.py e
 # production config: tile=128, rows chunked (lax.map) - PERF.md
 step kernel-full-shape 560 python kdiag.py full
 echo "=== fused bench (north-star; fused is the TPU default)"
-if probe; then timeout 560 python bench.py; fi
+if probe; then
+  SAGECAL_TELEMETRY=1 SAGECAL_EVENT_LOG="$MANIFEST_DIR/bench.jsonl" \
+    timeout 560 python bench.py
+  # the bench must have logged a valid manifest + its result event
+  timeout 60 python -m sagecal_tpu.obs.diag validate \
+    "$MANIFEST_DIR/bench.jsonl" || { echo "bench event log invalid"; exit 1; }
+  timeout 60 python -m sagecal_tpu.obs.diag events "$MANIFEST_DIR/bench.jsonl"
+fi
 echo "=== bf16-coherency fused bench"
 if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
+echo "=== telemetry-enabled test pass (CPU, marker-driven)"
+JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 900 \
+  python -m pytest tests/ -q -m telemetry -p no:cacheprovider | tail -3
+rc=${PIPESTATUS[0]}
+if [ "$rc" != 0 ]; then echo "telemetry test pass FAILED rc=$rc"; exit 1; fi
