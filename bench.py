@@ -334,38 +334,60 @@ def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ):
         args = (*prep(*args[:3]), args[3])
     else:
         step = make_step(data, cdata)
-    xla_flops = None
+    from sagecal_tpu.obs.perf import device_memory_snapshot, note_compile
+    from sagecal_tpu.utils.profiling import trace
+
+    perf = {"flops": None, "bytes_accessed": None,
+            "peak_device_memory_bytes": None}
     if want_flops:
         # AOT-compile once and reuse the executable for the timing loop
         # (calling the jit wrapper after .lower().compile() would trace
         # and compile the identical program a second time).  The
-        # cost_analysis() figure is recorded for transparency only —
-        # round 2 measured it untrustworthy on axon (35 MFLOP for a
+        # cost_analysis() figures are recorded for transparency only —
+        # round 2 measured flops untrustworthy on axon (35 MFLOP for a
         # ~2.5 GFLOP evaluation); the headline uses analytic FLOPs.
         try:
-            compiled = step.lower(*args).compile()
+            t0 = time.perf_counter()
+            lowered = step.lower(*args)
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            t2 = time.perf_counter()
             cost = compiled.cost_analysis()
             if isinstance(cost, (list, tuple)):
                 cost = cost[0]
-            xla_flops = float(cost.get("flops", 0.0)) or None
+            perf["flops"] = float(cost.get("flops", 0.0)) or None
+            perf["bytes_accessed"] = (
+                float(cost.get("bytes accessed", 0.0)) or None
+            )
+            # report through the obs/perf channel so `diag perf` on the
+            # bench event log attributes this compile like any other
+            note_compile("bench_step_fused" if FUSED else "bench_step_xla",
+                         t1 - t0, t2 - t1, perf["flops"],
+                         perf["bytes_accessed"])
             step = compiled
         except Exception:
-            xla_flops = None
-    out = step(*args)  # compile (if not AOT) + first run
-    iters = int(np.asarray(out[2]))  # host read = the only real sync
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        out = step(*args)
-        # Sync by transferring the SCALAR cost to host:
-        # jax.block_until_ready is a NO-OP on the axon backend (measured
-        # 0.2 ms for a 2.6 s computation) — only a host read observes
-        # completion.  A 4-byte transfer adds ~ms of tunnel RTT,
-        # negligible against the solve.
-        float(np.asarray(out[1]))
-        times.append(time.perf_counter() - t0)
+            pass
+    # SAGECAL_PROFILE_DIR additionally captures an XLA trace of the
+    # warm-up + timing loop (no-op when unset)
+    with trace():
+        out = step(*args)  # compile (if not AOT) + first run
+        iters = int(np.asarray(out[2]))  # host read = the only real sync
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = step(*args)
+            # Sync by transferring the SCALAR cost to host:
+            # jax.block_until_ready is a NO-OP on the axon backend
+            # (measured 0.2 ms for a 2.6 s computation) — only a host
+            # read observes completion.  A 4-byte transfer adds ~ms of
+            # tunnel RTT, negligible against the solve.
+            float(np.asarray(out[1]))
+            times.append(time.perf_counter() - t0)
+    snap = device_memory_snapshot(dev)
+    if snap.get("source") == "device":
+        perf["peak_device_memory_bytes"] = snap.get("peak_bytes_in_use")
     dt = float(np.median(times))
-    return max(iters, 1) / dt, iters, dt, xla_flops
+    return max(iters, 1) / dt, iters, dt, perf
 
 
 def _measure_cpu_subprocess(tilesz=TILESZ, timeout=1800.0):
@@ -427,9 +449,10 @@ def main():
     on_tpu = platform not in ("cpu",)
     tilesz = TILESZ if on_tpu else 5
     repeats = REPEATS if on_tpu else 1
-    value, iters, dt, xla_flops = run(
+    value, iters, dt, perf = run(
         np.float32, repeats=repeats, want_flops=True, tilesz=tilesz
     )
+    xla_flops = perf.get("flops")
 
     cpu_measured = None
     if os.environ.get("SAGECAL_BENCH_MEASURE_CPU"):
@@ -507,6 +530,12 @@ def main():
     }
     if xla_flops:
         rec["xla_cost_analysis_tflops_per_sec"] = round(xla_flops / dt / 1e12, 4)
+    # gate-able absolutes (diag gate): compiled-program bytes accessed
+    # and the device allocator's peak watermark for the bench process
+    if perf.get("bytes_accessed"):
+        rec["xla_cost_analysis_bytes_accessed"] = perf["bytes_accessed"]
+    if perf.get("peak_device_memory_bytes"):
+        rec["peak_device_memory_bytes"] = perf["peak_device_memory_bytes"]
     # North-star-shape same-core evidence, in the artifact rather than
     # round-notes prose: both sides measured solo on this host's single
     # core (ref_bench.py / _measure_cpu_subprocess, 2026-07-30).
@@ -535,6 +564,9 @@ def main():
         if not probe_ok or init_failed:
             elog.emit("fallback_to_cpu", platform=platform,
                       backend_init_failed=init_failed)
+        from sagecal_tpu.obs.perf import emit_perf_events
+
+        emit_perf_events(elog)
         elog.emit("bench_result", **rec)
         elog.close()
     print(json.dumps(rec))

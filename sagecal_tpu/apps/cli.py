@@ -6,7 +6,8 @@ Mode dispatch mirrors main.cpp:295-307: ``-N``>0 with ``-A``>0 and
 The input is a vis.h5 dataset (convert an MS with
 ``python -m sagecal_tpu.apps.cli convert <ms> <h5>`` where casacore is
 available).  ``sagecal-tpu diag ...`` exposes the observability tooling
-(run manifests, JSONL event-log summaries, Prometheus export).
+(run manifests, JSONL event-log summaries, Prometheus export, the
+``perf`` attribution table, and the ``gate`` bench-regression check).
 """
 
 from __future__ import annotations
@@ -221,7 +222,8 @@ def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "diag":
         # observability diagnostics: manifests, event-log summaries,
-        # Prometheus export (obs/diag.py)
+        # Prometheus export, perf attribution, regression gate
+        # (obs/diag.py)
         from sagecal_tpu.obs.diag import main as diag_main
 
         return diag_main(argv[1:])

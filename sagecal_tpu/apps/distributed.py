@@ -336,9 +336,17 @@ def _run_distributed_inner(
         TilePrefetcher(path, full_t0s, spec, cfg.tilesz, depth=1)
         for path in datasets
     ]
-    from sagecal_tpu.utils.profiling import PhaseTimer
+    from sagecal_tpu.obs.perf import TransferAudit, emit_perf_events
+    from sagecal_tpu.utils.profiling import PhaseTimer, trace
 
     timer = PhaseTimer()
+    # manual enter so the existing try/finally below owns the exits
+    # (exception-safe: a crash still flushes a loadable XLA trace)
+    trace_cm = trace()
+    if trace_cm.__enter__():
+        log("profiling: XLA trace enabled")
+    audit = TransferAudit()
+    audit.__enter__()
 
     def _prepare_tile(t0, zdiff):
         """Load + precompute one tile's per-band arrays.  All device
@@ -501,7 +509,10 @@ def _run_distributed_inner(
             f"[{timer.tile_summary()}]"
         )
       log(f"phases: {timer.run_summary()}")
+      audit.__exit__(None, None, None)
       if elog is not None:
+          emit_perf_events(elog)
+          audit.emit(elog)
           elog.emit("run_done", n_tiles=len(traces),
                     phase_totals=dict(timer.totals))
           elog.close()
@@ -520,8 +531,12 @@ def _run_distributed_inner(
           )
           log(f"spatial model plot -> {ppm_path}")
     finally:
-        # reap every band's prefetch thread even on a mid-loop failure
+        # reap every band's prefetch thread even on a mid-loop failure;
+        # the audit exit is idempotent (already closed on the happy
+        # path above) and the trace CM only stops a trace it started
         for pf in prefetchers:
             pf.__exit__(None, None, None)
+        audit.__exit__(None, None, None)
+        trace_cm.__exit__(None, None, None)
 
     return traces

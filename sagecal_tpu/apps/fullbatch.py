@@ -206,12 +206,24 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
 
     # first-class profiling (SURVEY section 5): per-phase wall-clock
     # always on; SAGECAL_PROFILE_DIR additionally captures an XLA trace
-    from sagecal_tpu.utils.profiling import PhaseTimer, start_trace, stop_trace
+    # and SAGECAL_TRANSFER_AUDIT=1 logs implicit host<->device transfers
+    from sagecal_tpu.obs.perf import (
+        TransferAudit,
+        dump_memory_profile,
+        emit_perf_events,
+    )
+    from sagecal_tpu.utils.profiling import PhaseTimer, trace
 
     timer = PhaseTimer()
-    trace_dir = start_trace()
+    # entered by hand (not `with`) so the existing try/finally below can
+    # own the exits without reindenting the whole tile loop; the finally
+    # guarantees a crashed run still flushes a loadable trace
+    trace_cm = trace()
+    trace_dir = trace_cm.__enter__()
     if trace_dir:
         log(f"profiling: XLA trace -> {trace_dir}")
+    audit = TransferAudit()
+    audit.__enter__()
 
     results = []
     # -K/-T partial reruns (MPI/main.cpp:133-139) resolved up front so
@@ -398,14 +410,19 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
 
     finally:
         # always reap the worker thread + its read handle, even when the
-        # solve/write raises mid-loop
+        # solve/write raises mid-loop; same for the transfer audit (its
+        # counts survive exit) and the XLA trace
         prefetch_cm.__exit__(None, None, None)
+        audit.__exit__(None, None, None)
+        trace_cm.__exit__(None, None, None)
     log(timer.run_summary())
     if elog is not None:
+        emit_perf_events(elog)
+        audit.emit(elog)
         elog.emit("run_done", n_tiles=len(results),
                   phase_totals=dict(timer.totals))
         elog.close()
-    stop_trace()
+    dump_memory_profile()
     if sol_fh:
         sol_fh.close()
     ds.close()

@@ -53,7 +53,21 @@ def _band_visdata(full, c0, c1):
 
 def run_minibatch(cfg: RunConfig, log=print):
     """Epochs x minibatches over time, one solution per mini-band.
-    Returns per-band final (res_0, res_1)."""
+    Returns per-band final (res_0, res_1).
+
+    Thin exception-safe shell: the XLA trace (``SAGECAL_PROFILE_DIR``)
+    and the transfer audit (``SAGECAL_TRANSFER_AUDIT=1``) are opened
+    here so a crash mid-epoch still flushes a loadable trace and
+    restores stderr."""
+    from sagecal_tpu.obs.perf import TransferAudit
+    from sagecal_tpu.utils.profiling import trace
+
+    audit = TransferAudit()
+    with trace(), audit:
+        return _run_minibatch(cfg, log, audit)
+
+
+def _run_minibatch(cfg: RunConfig, log, audit):
     dtype = np.float64 if cfg.use_f64 else np.float32
     cdtype = np.complex128 if cfg.use_f64 else np.complex64
     ds = VisDataset(cfg.dataset, "r+")
@@ -244,6 +258,13 @@ def run_minibatch(cfg: RunConfig, log=print):
             elog.emit("band_residual", band=bi, res0=r0, res1=r1)
         log(f"band {bi}: residual {r0:.4f} -> {r1:.4f}")
     if elog is not None:
+        from sagecal_tpu.obs.perf import emit_perf_events
+
+        # close the audit now (idempotent; the shell's exit is then a
+        # no-op) so its counts land in this run's event log
+        audit.__exit__(None, None, None)
+        emit_perf_events(elog)
+        audit.emit(elog)
         elog.emit("run_done", n_bands=len(bands))
         elog.close()
 

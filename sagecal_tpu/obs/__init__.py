@@ -10,11 +10,15 @@ Four pieces (SURVEY section 5 "observability"):
   auxiliary pytree outputs; host-callback-free by construction.
 - :mod:`sagecal_tpu.obs.events` — ``RunManifest`` + append-only JSONL
   event log (``SAGECAL_TELEMETRY=1`` / ``SAGECAL_EVENT_LOG=...``).
+- :mod:`sagecal_tpu.obs.perf` — performance observability:
+  ``instrumented_jit`` compile/recompile tracking, device-memory
+  watermarks, the transfer-guard audit, and the bench regression gate.
 - :mod:`sagecal_tpu.obs.diag` — the ``sagecal-tpu diag`` CLI.
 
-This package root imports neither jax nor numpy, so ``from sagecal_tpu
-.obs import telemetry_enabled`` is safe anywhere, including before
-backend selection.
+This package root imports neither jax nor numpy (obs.perf defers its
+jax imports to call time), so ``from sagecal_tpu.obs import
+telemetry_enabled`` is safe anywhere, including before backend
+selection.
 """
 
 from sagecal_tpu.obs.registry import (  # noqa: F401
@@ -32,6 +36,14 @@ from sagecal_tpu.obs.events import (  # noqa: F401
     read_events,
     validate_manifest,
 )
+from sagecal_tpu.obs.perf import (  # noqa: F401
+    TransferAudit,
+    device_memory_snapshot,
+    dump_memory_profile,
+    emit_perf_events,
+    instrumented_jit,
+    record_memory_watermark,
+)
 
 __all__ = [
     "MetricsRegistry",
@@ -45,4 +57,10 @@ __all__ = [
     "default_event_log",
     "read_events",
     "validate_manifest",
+    "TransferAudit",
+    "device_memory_snapshot",
+    "dump_memory_profile",
+    "emit_perf_events",
+    "instrumented_jit",
+    "record_memory_watermark",
 ]

@@ -14,6 +14,16 @@ Subcommands:
 - ``prom [--events FILE]`` — dump the in-process metrics registry in
   Prometheus text format (optionally re-ingesting phase timings from an
   event log first, so a finished run can be exported after the fact).
+- ``perf FILE_OR_DIR`` — performance attribution for a run: per-function
+  compile count / lower+compile seconds / flops / bytes from the
+  ``jit_compile`` events, per-phase device-memory watermarks, and the
+  transfer-audit summary.  Exit 1 when the log has no perf events (the
+  run was not telemetry-enabled or nothing instrumented ran).
+- ``gate NEW --baseline BASE [--tol T] [--metric name=tol ...]`` — the
+  perf-regression gate: compare a fresh bench JSON against the pinned
+  baseline with per-metric tolerances and direction semantics
+  (throughput dropping or bytes/memory rising beyond tolerance fails).
+  Exit 1 on any regression or when nothing is comparable.
 
 Runs standalone (``python -m sagecal_tpu.obs.diag ...``) or via the
 ``diag`` subcommand of the main CLI (:mod:`sagecal_tpu.apps.cli`).
@@ -30,6 +40,13 @@ from sagecal_tpu.obs.events import (
     RunManifest,
     read_events,
     validate_manifest,
+)
+from sagecal_tpu.obs.perf import (
+    GATE_DEFAULT_TOLERANCE,
+    aggregate_perf_events,
+    format_gate_report,
+    format_perf_report,
+    gate_compare,
 )
 from sagecal_tpu.obs.registry import get_registry, telemetry
 
@@ -153,6 +170,86 @@ def _cmd_prom(args) -> int:
     return 0
 
 
+def _cmd_perf(args) -> int:
+    import glob
+    import os
+
+    paths = [args.path]
+    if os.path.isdir(args.path):
+        paths = sorted(glob.glob(os.path.join(args.path, "*.jsonl")))
+        if not paths:
+            print(f"{args.path}: no *.jsonl event logs", file=sys.stderr)
+            return 1
+    evs: List[dict] = []
+    for p in paths:
+        evs.extend(read_events(p))
+    agg = aggregate_perf_events(evs)
+    print(format_perf_report(agg))
+    if not agg["functions"]:
+        # an empty attribution table means the run was not perf-observable
+        # — fail so CI catches a silently un-instrumented pipeline
+        return 1
+    return 0
+
+
+def _load_record(path: str) -> Optional[dict]:
+    """A bench record: a JSON dict, or the last ``bench_result``-shaped
+    line of a JSONL stream (bench.py prints one record per line)."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    try:
+        d = json.loads(text)
+        if isinstance(d, dict):
+            return d
+    except json.JSONDecodeError:
+        pass
+    rec = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(d, dict) and isinstance(d.get("value"), (int, float)):
+            rec = d
+    return rec
+
+
+def _cmd_gate(args) -> int:
+    new = _load_record(args.new)
+    base = _load_record(args.baseline)
+    if new is None or base is None:
+        which = args.new if new is None else args.baseline
+        print(f"{which}: no bench record found", file=sys.stderr)
+        return 1
+    p_new, p_base = new.get("platform"), base.get("platform")
+    if p_new and p_base and p_new != p_base and not args.strict:
+        print(f"gate: SKIP — platform mismatch ({p_new} vs baseline "
+              f"{p_base}); rerun with --strict to compare anyway")
+        return 0
+    tolerances = {}
+    for spec in args.metric or []:
+        name, _, tol = spec.partition("=")
+        try:
+            tolerances[name] = float(tol) if tol else args.tol
+        except ValueError:
+            print(f"bad --metric spec: {spec!r} (want name=tol)",
+                  file=sys.stderr)
+            return 2
+    failures, rows = gate_compare(new, base, tolerances=tolerances,
+                                  default_tol=args.tol)
+    print(format_gate_report(rows, failures))
+    for fail in failures:
+        print(f"REGRESSION: {fail}", file=sys.stderr)
+    if not rows:
+        # nothing comparable is itself a failure: the gate must never
+        # silently pass because a record lost its metrics
+        return 1
+    return 1 if failures else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="sagecal-tpu diag",
@@ -178,6 +275,26 @@ def build_parser() -> argparse.ArgumentParser:
     pp.add_argument("--events", default=None,
                     help="re-ingest phase timings from this event log first")
     pp.set_defaults(fn=_cmd_prom)
+
+    fp = sub.add_parser(
+        "perf", help="per-function compile/flops/bytes/memory attribution")
+    fp.add_argument("path",
+                    help="JSONL event log, or a run directory of *.jsonl")
+    fp.set_defaults(fn=_cmd_perf)
+
+    gp = sub.add_parser("gate", help="bench regression gate vs a baseline")
+    gp.add_argument("new", help="fresh bench JSON record")
+    gp.add_argument("--baseline", required=True,
+                    help="pinned baseline bench JSON record")
+    gp.add_argument("--tol", type=float, default=GATE_DEFAULT_TOLERANCE,
+                    help="default relative tolerance (default 0.10)")
+    gp.add_argument("--metric", action="append", default=None,
+                    metavar="NAME=TOL",
+                    help="gate an extra metric (repeatable), e.g. "
+                         "analytic_tflops_per_sec=0.15")
+    gp.add_argument("--strict", action="store_true",
+                    help="compare even across a platform mismatch")
+    gp.set_defaults(fn=_cmd_gate)
     return ap
 
 

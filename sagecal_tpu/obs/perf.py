@@ -1,0 +1,712 @@
+"""Performance observability: compile/recompile tracking, device-memory
+watermarks, host-transfer auditing, and the bench regression gate.
+
+PR 1 made the *science* observable (solver traces, ADMM residuals,
+manifests, JSONL events); this module makes the *performance*
+observable.  Four pieces:
+
+- :func:`instrumented_jit` — a drop-in ``jax.jit`` replacement adopted
+  by the solvers (``lm``/``robust``/``rtr``/``lbfgs``/``sage``), the
+  fused RIME kernel wrappers and the device-mesh ADMM driver.  With
+  telemetry off it is a single flag check on top of the plain jitted
+  call (the jaxpr, output signature, and jit cache are untouched).
+  With telemetry on it keys every call by an *abstract input
+  signature* (pytree structure + leaf shape/dtype + static-arg
+  values), AOT-compiles each new signature through
+  ``.lower()``/``.compile()`` so lowering and compile wall-times are
+  measured separately, pulls ``compiled.cost_analysis()`` flops/bytes,
+  and feeds everything into the PR-1 metrics registry plus a compile
+  event stream that apps drain into their JSONL logs.  The per-name
+  compile counter IS the recompile detector: a second compile of the
+  same name means a signature change (new shapes, a changed static
+  config) retraced the function.
+- device-memory watermarks (:func:`device_memory_snapshot`,
+  :func:`record_memory_watermark`) via ``device.memory_stats()`` with
+  a graceful host-RSS fallback on backends that expose no allocator
+  stats (CPU), plus an on-demand
+  ``jax.profiler.device_memory_profile`` dump
+  (:func:`dump_memory_profile`).
+- :class:`TransferAudit` — an opt-in ``jax.transfer_guard("log")``
+  context (``SAGECAL_TRANSFER_AUDIT=1``) that captures the guard's
+  C++ stderr lines, classifies host<->device transfers by direction,
+  and surfaces them as registry counters + a ``transfer_audit`` event.
+- the perf-regression gate (:func:`gate_compare`) behind
+  ``sagecal-tpu diag gate``: a fresh bench JSON is compared against a
+  pinned baseline with per-metric tolerances and direction semantics
+  (throughput up = good, bytes/memory up = bad); any out-of-tolerance
+  metric is a nonzero exit.  ``tpu_kernel_check.sh`` runs it after the
+  fused bench, turning the BENCH_*.json trajectory into a contract.
+
+Everything here is host-side; nothing touches a tracer.  jax/numpy are
+imported lazily so ``sagecal_tpu.obs`` stays importable before backend
+selection.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from sagecal_tpu.obs.registry import get_registry, telemetry_enabled
+
+_TRUTHY = ("1", "true", "yes", "on")
+_AUDIT_ENV = "SAGECAL_TRANSFER_AUDIT"
+_MEMPROF_ENV = "SAGECAL_MEMORY_PROFILE"
+
+# ------------------------------------------------------------------ store
+
+_LOCK = threading.Lock()
+# per-function aggregates: name -> dict(compiles, lower_seconds,
+# compile_seconds, flops, bytes_accessed, dispatches)
+_FN_STATS: Dict[str, Dict[str, float]] = {}
+# compile event stream the apps drain into their JSONL logs (bounded:
+# a runaway retrace loop must not grow host memory without bound)
+_COMPILE_EVENTS: List[dict] = []
+_MAX_COMPILE_EVENTS = 4096
+# per-phase peak-memory watermarks (bytes)
+_WATERMARKS: Dict[str, float] = {}
+
+
+def reset_perf_stats() -> None:
+    """Clear the module-level perf store (tests)."""
+    with _LOCK:
+        _FN_STATS.clear()
+        _COMPILE_EVENTS.clear()
+        _WATERMARKS.clear()
+
+
+def perf_stats() -> Dict[str, Dict[str, float]]:
+    """Per-instrumented-function aggregate snapshot."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _FN_STATS.items()}
+
+
+def drain_compile_events() -> List[dict]:
+    """Return and clear the pending compile events (app -> JSONL)."""
+    with _LOCK:
+        evs, _COMPILE_EVENTS[:] = list(_COMPILE_EVENTS), []
+    return evs
+
+
+def note_compile(name: str, lower_seconds: float, compile_seconds: float,
+                 flops: Optional[float] = None,
+                 bytes_accessed: Optional[float] = None,
+                 signature: str = "", aot: bool = True) -> dict:
+    """Record one compilation of ``name`` into the registry, the
+    per-function aggregates, and the compile event stream.  Public so
+    code that already AOT-compiles itself (bench.py) reports through
+    the same channel as :func:`instrumented_jit`."""
+    with _LOCK:
+        st = _FN_STATS.setdefault(name, {
+            "compiles": 0, "lower_seconds": 0.0, "compile_seconds": 0.0,
+            "flops": 0.0, "bytes_accessed": 0.0, "dispatches": 0,
+        })
+        st["compiles"] += 1
+        st["lower_seconds"] += lower_seconds
+        st["compile_seconds"] += compile_seconds
+        if flops:
+            st["flops"] = float(flops)
+        if bytes_accessed:
+            st["bytes_accessed"] = float(bytes_accessed)
+        n = st["compiles"]
+        ev = {
+            "fn": name, "signature": signature, "n_compiles": n,
+            "lower_seconds": round(lower_seconds, 6),
+            "compile_seconds": round(compile_seconds, 6),
+            "flops": flops, "bytes_accessed": bytes_accessed, "aot": aot,
+        }
+        if len(_COMPILE_EVENTS) < _MAX_COMPILE_EVENTS:
+            _COMPILE_EVENTS.append(ev)
+    reg = get_registry()
+    reg.counter_inc(
+        "jit_compiles_total", 1.0,
+        help="XLA compilations per instrumented function (a count > 1 "
+             "for one fn means a recompile: new shapes or a changed "
+             "static config)", fn=name,
+    )
+    reg.observe("jit_lower_seconds", lower_seconds,
+                help="trace+lower wall-time per compilation", fn=name)
+    reg.observe("jit_compile_seconds", compile_seconds,
+                help="XLA compile wall-time per compilation", fn=name)
+    if flops:
+        reg.gauge_set("xla_cost_analysis_flops", float(flops),
+                      help="compiled.cost_analysis() flops of the last "
+                           "compilation", fn=name)
+    if bytes_accessed:
+        reg.gauge_set("xla_cost_analysis_bytes_accessed",
+                      float(bytes_accessed),
+                      help="compiled.cost_analysis() bytes accessed of "
+                           "the last compilation", fn=name)
+    return ev
+
+
+def _cost_analysis(compiled) -> Tuple[Optional[float], Optional[float]]:
+    """(flops, bytes_accessed) from a Compiled, or (None, None).  The
+    axon TPU backend under-reports flops (BENCH_r02: ~35 MFLOP for a
+    ~2.5 GFLOP program) — record for attribution, don't headline."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0)) or None
+        by = float(cost.get("bytes accessed", 0.0)) or None
+        return flops, by
+    except Exception:
+        return None, None
+
+
+# -------------------------------------------------------- instrumented_jit
+
+
+def _as_tuple(x) -> tuple:
+    if x is None:
+        return ()
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,)
+
+
+class _InstrumentedJit:
+    """Callable wrapper produced by :func:`instrumented_jit`."""
+
+    def __init__(self, fn: Callable, name: Optional[str], jit_kwargs: dict):
+        import jax
+
+        self._fn = fn
+        self.name = name or getattr(fn, "__name__", repr(fn))
+        self._jitted = jax.jit(fn, **jit_kwargs)
+        self._static_argnums = frozenset(
+            int(i) for i in _as_tuple(jit_kwargs.get("static_argnums"))
+        )
+        self._static_argnames = frozenset(
+            _as_tuple(jit_kwargs.get("static_argnames"))
+        )
+        # donated buffers make the AOT executable single-shot-unsafe to
+        # share with the jit cache; fall back to first-call timing there
+        self._aot_ok = not any(k.startswith("donate") for k in jit_kwargs)
+        # signature -> Compiled (AOT path) | None (seen, jit-cache path)
+        self._compiled: Dict[Any, Any] = {}
+        self.__wrapped__ = fn
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    # -- signature keying ------------------------------------------------
+    def _leaf_desc(self, x) -> str:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            return f"{dtype}{tuple(shape)}"
+        # dynamic python scalars are traced weak-typed: the VALUE does
+        # not retrace, only the type does
+        if isinstance(x, (bool, int, float, complex)):
+            return f"py:{type(x).__name__}"
+        return repr(x)
+
+    def _sig_key(self, args, kwargs):
+        import jax
+
+        stat = tuple(
+            (i, repr(args[i])) for i in sorted(self._static_argnums)
+            if i < len(args)
+        ) + tuple(
+            (k, repr(kwargs[k])) for k in sorted(self._static_argnames)
+            if k in kwargs
+        )
+        dyn_args = tuple(
+            a for i, a in enumerate(args) if i not in self._static_argnums
+        )
+        dyn_kwargs = {
+            k: v for k, v in kwargs.items() if k not in self._static_argnames
+        }
+        leaves, treedef = jax.tree_util.tree_flatten((dyn_args, dyn_kwargs))
+        return (stat, str(treedef), tuple(self._leaf_desc(x) for x in leaves))
+
+    def _dyn_call_args(self, args, kwargs):
+        dyn_args = tuple(
+            a for i, a in enumerate(args) if i not in self._static_argnums
+        )
+        dyn_kwargs = {
+            k: v for k, v in kwargs.items() if k not in self._static_argnames
+        }
+        return dyn_args, dyn_kwargs
+
+    # -- compile paths ---------------------------------------------------
+    def _aot_compile(self, sig, args, kwargs):
+        t0 = time.perf_counter()
+        lowered = self._jitted.lower(*args, **kwargs)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        flops, by = _cost_analysis(compiled)
+        note_compile(self.name, t1 - t0, t2 - t1, flops, by,
+                     signature=_sig_hash(sig), aot=True)
+        return compiled
+
+    def __call__(self, *args, **kwargs):
+        if not telemetry_enabled():
+            return self._jitted(*args, **kwargs)
+        sig = self._sig_key(args, kwargs)
+        entry = self._compiled.get(sig)
+        get_registry().counter_inc(
+            "jit_dispatches_total", 1.0,
+            help="calls into instrumented jitted functions", fn=self.name,
+        )
+        if entry is None and sig not in self._compiled:
+            if self._aot_ok:
+                try:
+                    entry = self._aot_compile(sig, args, kwargs)
+                except Exception:
+                    entry = None
+            if entry is None:
+                # AOT refused (donation, exotic args): time the first
+                # dispatch — compile + first execution together
+                t0 = time.perf_counter()
+                out = self._jitted(*args, **kwargs)
+                dt = time.perf_counter() - t0
+                note_compile(self.name, 0.0, dt, signature=_sig_hash(sig),
+                             aot=False)
+                self._compiled[sig] = None
+                return out
+            self._compiled[sig] = entry
+        if entry is not None:
+            dyn_args, dyn_kwargs = self._dyn_call_args(args, kwargs)
+            try:
+                return entry(*dyn_args, **dyn_kwargs)
+            except Exception:
+                # sharding/commitment mismatch with the AOT executable:
+                # permanently route this signature through the jit cache
+                self._compiled[sig] = None
+        return self._jitted(*args, **kwargs)
+
+    # passthroughs so the wrapper stays a drop-in jax.jit replacement
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    def clear_cache(self) -> None:
+        self._compiled.clear()
+        try:
+            self._jitted.clear_cache()
+        except Exception:
+            pass
+
+    @property
+    def compiles(self) -> int:
+        """Compilations recorded under this wrapper's name (aggregated
+        across wrapper instances sharing the name)."""
+        return int(perf_stats().get(self.name, {}).get("compiles", 0))
+
+
+def _sig_hash(sig) -> str:
+    return hashlib.sha1(repr(sig).encode()).hexdigest()[:12]
+
+
+def instrumented_jit(fn: Optional[Callable] = None, *,
+                     name: Optional[str] = None, **jit_kwargs):
+    """``jax.jit`` with compile/recompile telemetry (module docstring).
+
+    Usable bare (``instrumented_jit(f)``), with options
+    (``instrumented_jit(f, name="solver", static_argnames=("cfg",))``)
+    or as a decorator factory.  All other keyword arguments pass
+    through to ``jax.jit``.
+    """
+    if fn is None:
+        def deco(f):
+            return _InstrumentedJit(f, name, jit_kwargs)
+        return deco
+    return _InstrumentedJit(fn, name, jit_kwargs)
+
+
+# ------------------------------------------------------------ device memory
+
+
+def device_memory_snapshot(device=None) -> dict:
+    """Current/peak device-memory bytes.  ``device.memory_stats()``
+    where the backend exposes allocator stats (TPU/GPU); graceful
+    fallback to host RSS (``source: host_rss``) on backends that
+    return None (CPU) or raise — the numbers stay meaningful for the
+    host-side pipeline stages."""
+    stats = None
+    kind = "unknown"
+    try:
+        import jax
+
+        device = device or jax.local_devices()[0]
+        kind = getattr(device, "device_kind", "unknown")
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        inuse = stats.get("bytes_in_use", 0)
+        return {
+            "source": "device",
+            "device_kind": kind,
+            "bytes_in_use": int(inuse),
+            "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", inuse)),
+            "bytes_limit": int(stats["bytes_limit"])
+            if "bytes_limit" in stats else None,
+        }
+    rss = peak = 0
+    try:
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        with open("/proc/self/statm") as f:
+            rss = int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        rss = rss or peak
+    return {
+        "source": "host_rss",
+        "device_kind": kind,
+        "bytes_in_use": int(rss or peak),
+        "peak_bytes_in_use": int(peak or rss),
+        "bytes_limit": None,
+    }
+
+
+def record_memory_watermark(phase: str, device=None) -> Optional[dict]:
+    """Sample the device-memory snapshot and fold its peak into the
+    per-``phase`` watermark (registry gauge ``peak_device_memory_bytes``
+    + the module store :func:`memory_watermarks` reads).  No-op (None)
+    when telemetry is off, so hot paths call it unguarded."""
+    if not telemetry_enabled():
+        return None
+    snap = device_memory_snapshot(device)
+    peak = float(snap.get("peak_bytes_in_use") or 0)
+    with _LOCK:
+        if peak > _WATERMARKS.get(phase, -1.0):
+            _WATERMARKS[phase] = peak
+    reg = get_registry()
+    prev = reg.get_gauge("peak_device_memory_bytes", phase=phase)
+    if prev is None or peak > prev:
+        reg.gauge_set(
+            "peak_device_memory_bytes", peak,
+            help="peak device (or host-RSS fallback) bytes observed per "
+                 "pipeline phase", phase=phase,
+        )
+    reg.gauge_set("device_memory_bytes_in_use",
+                  float(snap.get("bytes_in_use") or 0),
+                  help="device bytes in use at the last phase sample",
+                  phase=phase)
+    return snap
+
+
+def memory_watermarks() -> Dict[str, float]:
+    """Per-phase peak bytes recorded so far (for the run-end event)."""
+    with _LOCK:
+        return dict(_WATERMARKS)
+
+
+def dump_memory_profile(path: Optional[str] = None) -> Optional[str]:
+    """Write a ``jax.profiler.device_memory_profile()`` pprof dump to
+    ``path`` (default: the ``SAGECAL_MEMORY_PROFILE`` env var; no-op
+    returning None when neither is set or the profiler fails)."""
+    path = path or os.environ.get(_MEMPROF_ENV)
+    if not path:
+        return None
+    try:
+        import jax
+
+        prof = jax.profiler.device_memory_profile()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(prof)
+        return path
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------- transfer audit
+
+
+def transfer_audit_enabled() -> bool:
+    return os.environ.get(_AUDIT_ENV, "").strip().lower() in _TRUTHY
+
+
+class TransferAudit:
+    """Opt-in implicit host<->device transfer audit.
+
+    Inside the context, ``jax.transfer_guard("log")`` is active and the
+    guard's C++ log lines (``guard_lib.cc`` writes straight to fd 2 —
+    Python logging never sees them) are captured through an fd-level
+    stderr redirect.  On exit the captured stream is replayed to the
+    real stderr (nothing is swallowed), lines are classified by
+    direction into :attr:`counts`, samples are kept, and registry
+    counters ``transfer_guard_transfers_total{direction=...}`` are
+    bumped.  ``emit(elog)`` writes one ``transfer_audit`` event.
+
+    Disabled (``enabled=False`` / env unset) the context is a no-op, so
+    apps wrap their loops unconditionally."""
+
+    _MARKS = (
+        ("host-to-device transfer:", "host_to_device"),
+        ("device-to-host transfer:", "device_to_host"),
+        ("device-to-device transfer:", "device_to_device"),
+    )
+
+    def __init__(self, enabled: Optional[bool] = None, max_samples: int = 20):
+        self.enabled = transfer_audit_enabled() if enabled is None else enabled
+        self.max_samples = max_samples
+        self.counts: Dict[str, int] = {}
+        self.samples: List[str] = []
+        self._guard = None
+        self._tmp = None
+        self._saved_fd = None
+
+    def __enter__(self) -> "TransferAudit":
+        if not self.enabled:
+            return self
+        import jax
+
+        self._guard = jax.transfer_guard("log")
+        self._guard.__enter__()
+        try:
+            sys.stderr.flush()
+        except Exception:
+            pass
+        self._tmp = tempfile.TemporaryFile()
+        self._saved_fd = os.dup(2)
+        os.dup2(self._tmp.fileno(), 2)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        # idempotent: apps close the audit before emitting its counts
+        # AND in a finally for the exception path
+        if not self.enabled or self._saved_fd is None:
+            return False
+        try:
+            sys.stderr.flush()
+        except Exception:
+            pass
+        os.dup2(self._saved_fd, 2)
+        os.close(self._saved_fd)
+        self._saved_fd = None
+        self._guard.__exit__(*exc)
+        self._tmp.seek(0)
+        text = self._tmp.read().decode("utf-8", errors="replace")
+        self._tmp.close()
+        if text:
+            # replay: warnings and guard lines stay visible on stderr
+            try:
+                sys.stderr.write(text)
+                sys.stderr.flush()
+            except Exception:
+                pass
+        for line in text.splitlines():
+            for mark, direction in self._MARKS:
+                if mark in line:
+                    self.counts[direction] = self.counts.get(direction, 0) + 1
+                    if len(self.samples) < self.max_samples:
+                        self.samples.append(line[line.index(mark):][:200])
+                    break
+        reg = get_registry()
+        for direction, n in self.counts.items():
+            reg.counter_inc(
+                "transfer_guard_transfers_total", float(n),
+                help="implicit transfers observed by the "
+                     "SAGECAL_TRANSFER_AUDIT=1 jax.transfer_guard audit",
+                direction=direction,
+            )
+        return False
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def emit(self, elog) -> None:
+        """One ``transfer_audit`` JSONL event (no-op when disabled or
+        the app runs without an event log)."""
+        if elog is None or not self.enabled:
+            return
+        elog.emit("transfer_audit", counts=self.counts, total=self.total,
+                  samples=self.samples)
+
+
+# ----------------------------------------------- app-side emit convenience
+
+
+def emit_perf_events(elog, device=None) -> None:
+    """Drain pending compile events and the memory watermarks into an
+    app's JSONL event log (one ``jit_compile`` event per compilation +
+    one ``memory_watermark`` summary).  Safe to call with ``elog=None``
+    (events stay queued for a later drain) and at any cadence."""
+    if elog is None:
+        return
+    for ev in drain_compile_events():
+        elog.emit("jit_compile", **ev)
+    marks = memory_watermarks()
+    if marks:
+        elog.emit("memory_watermark", phases=marks,
+                  snapshot=device_memory_snapshot(device))
+
+
+# ----------------------------------------------------- diag perf aggregation
+
+
+def aggregate_perf_events(events: List[dict]) -> dict:
+    """Fold a JSONL event list into the ``diag perf`` attribution
+    tables: per-function compile stats, per-phase memory watermarks,
+    and transfer-audit counts."""
+    fns: Dict[str, Dict[str, float]] = {}
+    mem: Dict[str, float] = {}
+    transfers: Dict[str, int] = {}
+    snapshot = None
+    for e in events:
+        t = e.get("type")
+        if t == "jit_compile":
+            st = fns.setdefault(str(e.get("fn", "?")), {
+                "compiles": 0, "lower_seconds": 0.0, "compile_seconds": 0.0,
+                "flops": 0.0, "bytes_accessed": 0.0,
+            })
+            st["compiles"] += 1
+            for k in ("lower_seconds", "compile_seconds"):
+                v = e.get(k)
+                if isinstance(v, (int, float)):
+                    st[k] += float(v)
+            for k in ("flops", "bytes_accessed"):
+                v = e.get(k)
+                if isinstance(v, (int, float)) and v:
+                    st[k] = float(v)
+        elif t == "memory_watermark":
+            for phase, v in (e.get("phases") or {}).items():
+                if isinstance(v, (int, float)):
+                    mem[str(phase)] = max(mem.get(str(phase), 0.0), float(v))
+            snapshot = e.get("snapshot") or snapshot
+        elif t == "transfer_audit":
+            for d, n in (e.get("counts") or {}).items():
+                if isinstance(n, (int, float)):
+                    transfers[str(d)] = transfers.get(str(d), 0) + int(n)
+    return {"functions": fns, "memory": mem, "transfers": transfers,
+            "memory_snapshot": snapshot}
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if not n:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def format_perf_report(agg: dict) -> str:
+    """Human table for ``diag perf`` from :func:`aggregate_perf_events`
+    output (also used on a live :func:`perf_stats` snapshot)."""
+    lines = []
+    fns = agg.get("functions") or {}
+    if fns:
+        w = max(len(n) for n in fns) + 2
+        lines.append(f"{'function':<{w}}{'compiles':>9}{'lower_s':>9}"
+                     f"{'compile_s':>11}{'gflops':>10}{'bytes':>10}")
+        for name in sorted(fns, key=lambda n: -fns[n]["compile_seconds"]):
+            st = fns[name]
+            gf = st.get("flops", 0.0) / 1e9
+            lines.append(
+                f"{name:<{w}}{int(st['compiles']):>9}"
+                f"{st['lower_seconds']:>9.2f}{st['compile_seconds']:>11.2f}"
+                f"{(f'{gf:.2f}' if gf else '-'):>10}"
+                f"{_fmt_bytes(st.get('bytes_accessed')):>10}"
+            )
+    else:
+        lines.append("no jit_compile events (run with SAGECAL_TELEMETRY=1 "
+                     "and an instrumented path)")
+    mem = agg.get("memory") or {}
+    if mem:
+        lines.append("memory watermarks (peak per phase):")
+        for phase in sorted(mem, key=mem.get, reverse=True):
+            lines.append(f"  {phase}: {_fmt_bytes(mem[phase])}")
+        snap = agg.get("memory_snapshot") or {}
+        if snap.get("source"):
+            lines.append(f"  source: {snap['source']} "
+                         f"({snap.get('device_kind', 'unknown')})")
+    transfers = agg.get("transfers") or {}
+    if transfers:
+        tot = sum(transfers.values())
+        parts = ", ".join(f"{d}={n}" for d, n in sorted(transfers.items()))
+        lines.append(f"transfer audit: {tot} implicit transfers ({parts})")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------- gate
+
+# metric direction semantics: a regression is a drop for higher-better
+# metrics and a rise for lower-better ones.  Metrics not listed are
+# informational and never gate.
+GATE_HIGHER_BETTER = (
+    "value", "vs_baseline", "vs_reference_cpu",
+    "analytic_tflops_per_sec", "analytic_hbm_gb_per_sec",
+    "mfu_vs_v5e_bf16_peak", "bw_util_vs_v5e_819gbps",
+)
+GATE_LOWER_BETTER = (
+    "xla_cost_analysis_bytes_accessed", "peak_device_memory_bytes",
+    "compile_seconds_total",
+)
+# the metrics gated when present in BOTH records (others opt in via
+# --metric name=tol)
+GATE_DEFAULT_METRICS = (
+    "value", "xla_cost_analysis_bytes_accessed", "peak_device_memory_bytes",
+)
+GATE_DEFAULT_TOLERANCE = 0.10
+
+
+def gate_compare(new: dict, baseline: dict,
+                 tolerances: Optional[Dict[str, float]] = None,
+                 default_tol: float = GATE_DEFAULT_TOLERANCE,
+                 metrics: Optional[Tuple[str, ...]] = None):
+    """Compare a fresh bench record against the pinned baseline.
+
+    Returns ``(failures, rows)``: ``failures`` is the list of
+    human-readable regression strings (empty = gate passes); ``rows``
+    is one ``(metric, base, new, ratio, tol, status)`` tuple per
+    compared metric for the report table.  A metric is compared when
+    it is numeric and non-zero in the baseline and present in the new
+    record; per-metric tolerances override ``default_tol``."""
+    tolerances = tolerances or {}
+    names = list(metrics if metrics is not None else GATE_DEFAULT_METRICS)
+    for extra in tolerances:
+        if extra not in names:
+            names.append(extra)
+    failures, rows = [], []
+    for m in names:
+        b, n = baseline.get(m), new.get(m)
+        if not isinstance(b, (int, float)) or not isinstance(n, (int, float)):
+            continue
+        if b == 0:
+            continue
+        tol = float(tolerances.get(m, default_tol))
+        ratio = float(n) / float(b)
+        if m in GATE_LOWER_BETTER:
+            bad = ratio > 1.0 + tol
+            direction = "rose"
+        else:
+            bad = ratio < 1.0 - tol
+            direction = "dropped"
+        status = "FAIL" if bad else "ok"
+        rows.append((m, float(b), float(n), ratio, tol, status))
+        if bad:
+            failures.append(
+                f"{m} {direction} beyond tolerance: baseline {b:g} -> "
+                f"{n:g} (ratio {ratio:.3f}, tol {tol:.0%})"
+            )
+    return failures, rows
+
+
+def format_gate_report(rows, failures) -> str:
+    lines = []
+    if rows:
+        w = max(len(r[0]) for r in rows) + 2
+        lines.append(f"{'metric':<{w}}{'baseline':>14}{'new':>14}"
+                     f"{'ratio':>8}{'tol':>7}  status")
+        for m, b, n, ratio, tol, status in rows:
+            lines.append(f"{m:<{w}}{b:>14.6g}{n:>14.6g}{ratio:>8.3f}"
+                         f"{tol:>6.0%}  {status}")
+    else:
+        lines.append("no comparable metrics between the two records")
+    lines.append("GATE: " + ("FAIL" if failures else "PASS"))
+    return "\n".join(lines)

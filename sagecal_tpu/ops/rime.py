@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from sagecal_tpu.obs.perf import instrumented_jit
 from sagecal_tpu.ops.special import bessel_j0, bessel_j1, sinc_abs
 
 # source types (mirror STYPE_* roles; values are our own)
@@ -327,7 +328,9 @@ def time_smear_factor(ll, mm, dec0, tdelta, u, v, w, freqs):
     return jnp.where(prod > 1e-12, 1.0645 * erf(0.8326 * safe) / safe, 1.0)
 
 
-@functools.partial(jax.jit, static_argnums=(6, 7, 8, 9, 10, 11))
+@functools.partial(
+    instrumented_jit, name="predict_coherencies",
+    static_argnums=(6, 7, 8, 9, 10, 11))
 def _predict_coherencies(
     u, v, w, freqs, src, shapelets, fdelta, source_chunk, has_extended,
     has_shapelet, tdelta, dec0,

@@ -673,3 +673,13 @@ def unpack_gain_grads(dre, dim, M: int, N: int):
     dre = jnp.transpose(dre[:, :M, :N], (1, 2, 0)).reshape(M, N, 2, 2)
     dim = jnp.transpose(dim[:, :M, :N], (1, 2, 0)).reshape(M, N, 2, 2)
     return dre, dim
+
+
+# Instrumented jitted entry for eager callers and bench: ``tile`` and
+# ``max_rows`` are compile-time grid parameters, so changing either is
+# a visible recompile in the obs/perf compile counter.
+from sagecal_tpu.obs.perf import instrumented_jit  # noqa: E402
+
+fused_predict_packed_chunked_jit = instrumented_jit(
+    fused_predict_packed_chunked, name="fused_predict_packed_chunked",
+    static_argnames=("tile", "max_rows"))

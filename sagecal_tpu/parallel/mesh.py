@@ -54,6 +54,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from sagecal_tpu.core.types import VisData, jones_to_params, params_to_jones
+from sagecal_tpu.obs.perf import instrumented_jit
 from sagecal_tpu.parallel import consensus
 from sagecal_tpu.parallel.admm import admm_sagefit
 from sagecal_tpu.parallel.manifold import manifold_average
@@ -432,7 +433,7 @@ def make_admm_mesh_fn(
 
     ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
 
-    @jax.jit
+    @instrumented_jit(name="mesh.admm")
     def fn(data_stack, cdata_stack, p0, rho, B):
         Nf = p0.shape[0]
         if Nf % ndev != 0:

@@ -319,3 +319,14 @@ def lbfgs_fit(
     )
     return LBFGSResult(p=x, memory=mem, cost=f, gradnorm=gradnrm,
                        iterations=ck, trace=trace)
+
+
+# jitted module entry with compile/recompile telemetry (obs/perf.py):
+# cost_fn/grad_fn are static (hashed by identity — a new closure is a
+# new signature), as are the compile-time loop bounds
+from sagecal_tpu.obs.perf import instrumented_jit  # noqa: E402
+
+lbfgs_fit_jit = instrumented_jit(
+    lbfgs_fit, name="lbfgs_fit",
+    static_argnames=("cost_fn", "grad_fn", "itmax", "M", "minibatch",
+                     "collect_trace"))

@@ -396,3 +396,18 @@ def os_lm_solve(
     )
     return LMResult(p=p, cost0=cost0, cost=final_cost,
                     iterations=jnp.asarray(config.itmax), trace=trace)
+
+
+# Jitted module entries (obs/perf.py): inside the packed SAGE solve
+# these solvers are traced as part of one big jit; the standalone
+# wrappers below are for eager callers (tests, notebooks, partial
+# pipelines) and record compile/recompile + cost-analysis telemetry
+# under SAGECAL_TELEMETRY=1.  A changed LMConfig is a new static
+# signature, i.e. a visible recompile.
+from sagecal_tpu.obs.perf import instrumented_jit  # noqa: E402
+
+lm_solve_jit = instrumented_jit(
+    lm_solve, name="lm_solve", static_argnames=("collect_trace",))
+os_lm_solve_jit = instrumented_jit(
+    os_lm_solve, name="os_lm_solve",
+    static_argnames=("nsubsets", "collect_trace"))

@@ -158,3 +158,13 @@ def whiten_uv_weights(u, v, freq0):
     ud = jnp.sqrt(u * u + v * v) * freq0
     w = 1.0 / (1.0 + 1.8 * jnp.exp(-0.05 * ud))
     return jnp.where(ud > 400.0, 1.0, w)
+
+
+# jitted module entry with compile/recompile telemetry (see
+# sagecal_tpu/obs/perf.py; the em_iters EM ladder is a static python
+# loop, so a changed em_iters is a visible recompile)
+from sagecal_tpu.obs.perf import instrumented_jit  # noqa: E402
+
+robust_lm_solve_jit = instrumented_jit(
+    robust_lm_solve, name="robust_lm_solve",
+    static_argnames=("em_iters", "collect_trace"))

@@ -698,3 +698,16 @@ def nsd_solve_robust(
         vis, coh, mask, ant_p, ant_q, chunk_map, p, nu, nulow, nuhigh
     )
     return RTRResult(p=p, cost0=c0s[0], cost=c1s[-1], trace=trace), nu
+
+
+# jitted module entries with compile/recompile telemetry (obs/perf.py)
+from sagecal_tpu.obs.perf import instrumented_jit  # noqa: E402
+
+rtr_solve_jit = instrumented_jit(
+    rtr_solve, name="rtr_solve", static_argnames=("collect_trace",))
+nsd_solve_jit = instrumented_jit(
+    nsd_solve, name="nsd_solve",
+    static_argnames=("itmax", "collect_trace"))
+rtr_solve_robust_jit = instrumented_jit(
+    rtr_solve_robust, name="rtr_solve_robust",
+    static_argnames=("em_iters", "collect_trace"))

@@ -42,6 +42,7 @@ import numpy as np
 from flax import struct
 
 from sagecal_tpu.core.types import VisData, corrupt_flat, params_to_jones
+from sagecal_tpu.obs.perf import instrumented_jit
 from sagecal_tpu.ops.rime import SourceBatch, predict_coherencies
 from sagecal_tpu.solvers.lbfgs import lbfgs_fit
 from sagecal_tpu.solvers.lm import LMConfig, lm_solve, os_lm_solve
@@ -207,7 +208,10 @@ def build_cluster_data(
         has_ext = bool(np.any(stypes != ST_POINT))
         empty_tab = ShapeletTable.empty(data.u.dtype)
 
-        @jax.jit
+        # NOTE: a fresh wrapper per build_cluster_data call — the shared
+        # "coherency_block" perf name aggregates them, so per-tile
+        # retraces of this closure show up as a growing compile count
+        @instrumented_jit(name="coherency_block")
         def _block(u, v, w, freqs, stacked):
             return jax.vmap(
                 lambda s: _predict_coherencies(
@@ -713,7 +717,12 @@ def sagefit_packed(
     )
 
 
-_sagefit_packed_jit = jax.jit(sagefit_packed)
+# instrumented jit (obs/perf.py): with SAGECAL_TELEMETRY=1 every new
+# abstract input signature — a new tile shape or a changed static
+# SageConfig — is visible as a recorded compile with lowering/compile
+# wall-time and cost_analysis() flops/bytes; telemetry off is the plain
+# jax.jit call
+_sagefit_packed_jit = instrumented_jit(sagefit_packed, name="sagefit_packed")
 
 
 def solve_tile(

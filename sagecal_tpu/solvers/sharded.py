@@ -30,6 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from sagecal_tpu.utils.platform import shard_map
 
 from sagecal_tpu.core.types import VisData
+from sagecal_tpu.obs.perf import instrumented_jit
 from sagecal_tpu.solvers.lbfgs import lbfgs_fit
 from sagecal_tpu.solvers.sage import ClusterData, predict_full_model
 
@@ -136,7 +137,7 @@ def make_sharded_joint_fn(
         in_specs=(data_specs, cdata_specs, P()),
         out_specs=(P(), P(), P()),
     )
-    return jax.jit(fn)
+    return instrumented_jit(fn, name="sharded_joint_fit")
 
 
 def sharded_joint_fit(

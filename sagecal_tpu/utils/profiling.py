@@ -13,10 +13,14 @@ feature of the rebuild.  Two layers:
   :func:`sagecal_tpu.obs.registry.get_registry`, so ``sagecal-tpu diag
   prom`` exports the same numbers Prometheus-style; :meth:`PhaseTimer.
   tile_timings` hands the per-tile window to the JSONL event log.
-- XLA device traces — set ``SAGECAL_PROFILE_DIR=/some/dir`` (or call
-  :func:`start_trace` yourself) to capture a TensorBoard-loadable
+- XLA device traces — set ``SAGECAL_PROFILE_DIR=/some/dir`` (or enter
+  :func:`trace` yourself) to capture a TensorBoard-loadable
   ``jax.profiler`` trace of the same run; phases are annotated with
   ``jax.profiler.TraceAnnotation`` so device ops attribute to them.
+  Apps use the :func:`trace` context manager, which stops the trace in
+  a ``finally`` — a crash mid-run flushes a loadable trace instead of
+  leaving a truncated one (the bare ``start_trace``/``stop_trace``
+  pair stays for REPL use).
 """
 
 from __future__ import annotations
@@ -28,6 +32,8 @@ from collections import defaultdict
 from typing import Dict, Iterator, Optional
 
 import jax
+
+from sagecal_tpu.obs.registry import get_registry, telemetry_enabled
 
 _TRACE_DIR_ENV = "SAGECAL_PROFILE_DIR"
 _active_trace: Optional[str] = None
@@ -55,6 +61,23 @@ def stop_trace() -> None:
         _active_trace = None
 
 
+@contextlib.contextmanager
+def trace(log_dir: Optional[str] = None) -> Iterator[Optional[str]]:
+    """Exception-safe XLA trace scope: starts a profiler trace when
+    requested (argument or ``SAGECAL_PROFILE_DIR``), yields the trace
+    directory (None when tracing is off), and ALWAYS stops the trace it
+    started on exit — including on an exception, so a crashed run still
+    leaves a TensorBoard-loadable trace.  Nested under an already
+    active trace it is a no-op passthrough (the owner stops it)."""
+    owner = _active_trace is None
+    d = start_trace(log_dir)
+    try:
+        yield d
+    finally:
+        if owner and d is not None:
+            stop_trace()
+
+
 class PhaseTimer:
     """Accumulates wall-clock per named phase across tiles."""
 
@@ -72,12 +95,17 @@ class PhaseTimer:
         self.totals[name] += dt
         self.counts[name] += 1
         self._tile[name] = self._tile.get(name, 0.0) + dt
-        from sagecal_tpu.obs.registry import get_registry
+        # zero-cost-off: one flag check and we're done — no import, no
+        # registry lookup, no label-key allocation on the hot path
+        if telemetry_enabled():
+            get_registry().observe(
+                "phase_seconds", dt,
+                help="wall-clock seconds per named pipeline phase",
+                phase=name,
+            )
+            from sagecal_tpu.obs.perf import record_memory_watermark
 
-        get_registry().observe(
-            "phase_seconds", dt,
-            help="wall-clock seconds per named pipeline phase", phase=name,
-        )
+            record_memory_watermark(name)
 
     def tile_timings(self) -> Dict[str, float]:
         """Snapshot of the current per-tile window (does not reset) —

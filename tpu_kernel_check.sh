@@ -38,11 +38,20 @@ step kernel-full-shape 560 python kdiag.py full
 echo "=== fused bench (north-star; fused is the TPU default)"
 if probe; then
   SAGECAL_TELEMETRY=1 SAGECAL_EVENT_LOG="$MANIFEST_DIR/bench.jsonl" \
-    timeout 560 python bench.py
+    timeout 560 python bench.py | tee "$MANIFEST_DIR/bench_new.json"
   # the bench must have logged a valid manifest + its result event
   timeout 60 python -m sagecal_tpu.obs.diag validate \
     "$MANIFEST_DIR/bench.jsonl" || { echo "bench event log invalid"; exit 1; }
   timeout 60 python -m sagecal_tpu.obs.diag events "$MANIFEST_DIR/bench.jsonl"
+  # perf attribution must be non-empty: an empty table means the bench
+  # silently lost its instrumentation
+  timeout 60 python -m sagecal_tpu.obs.diag perf "$MANIFEST_DIR/bench.jsonl" \
+    || { echo "diag perf found no compile events"; exit 1; }
+  # regression gate vs the pinned baseline (BENCH_BASELINE.json): >10%
+  # throughput drop or bytes/memory rise is a hard stop
+  timeout 60 python -m sagecal_tpu.obs.diag gate "$MANIFEST_DIR/bench_new.json" \
+    --baseline /root/repo/BENCH_BASELINE.json \
+    || { echo "PERF GATE FAILED vs BENCH_BASELINE.json"; exit 1; }
 fi
 echo "=== bf16-coherency fused bench"
 if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
