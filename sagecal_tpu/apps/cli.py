@@ -161,6 +161,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-i", "--influence", action="store_true",
                     help="write influence-function diagnostics instead of "
                     "residuals (ref -i)")
+    ap.add_argument("--abort-on-divergence", action="store_true",
+                    help="terminate (with a structured run_aborted event) "
+                    "when the quality watchdog reports a diverged solve; "
+                    "default is report-only")
     return ap
 
 
@@ -209,6 +213,7 @@ def config_from_args(args) -> RunConfig:
         verbose=args.verbose,
         influence=args.influence,
         use_fused_predict=args.fused,
+        abort_on_divergence=args.abort_on_divergence,
     )
 
 
@@ -238,6 +243,18 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     _warn_dropped_fused(args)
     cfg = config_from_args(args)
+    from sagecal_tpu.obs.quality import DivergenceAbort
+
+    try:
+        return _dispatch(args, cfg)
+    except DivergenceAbort as e:
+        # --abort-on-divergence: the run already emitted its structured
+        # run_aborted event; exit distinctly from argparse's 2
+        print(f"sagecal-tpu: {e}", file=sys.stderr)
+        return 3
+
+
+def _dispatch(args, cfg) -> int:
     # mode dispatch (main.cpp:295-307; -f selects the sagecal-mpi
     # equivalent, MPI/main.cpp:336)
     if args.band_pattern and cfg.epochs > 0:

@@ -66,6 +66,11 @@ class RunConfig:
     max_tiles: int = 0  # 0 = no limit
     # divergence guard (fullbatch_mode.cpp:250,618-632)
     res_ratio: float = 5.0
+    # quality watchdog escalation: report-only by default; True makes a
+    # diverged solve (non-finite gains/chi^2, residual-ratio blowup,
+    # ADMM consensus runaway) terminate the run with a structured
+    # run_aborted event (obs/quality.py DivergenceAbort)
+    abort_on_divergence: bool = False
     # influence-function diagnostics in place of residuals (-i,
     # diagnostics.c / fullbatch_mode.cpp:526-534)
     influence: bool = False

@@ -281,7 +281,9 @@ def _run_distributed_inner(
     # run lands in the JSONL event log as one admm_round event
     from sagecal_tpu.obs import RunManifest, default_event_log, telemetry_enabled
 
-    collect = telemetry_enabled()
+    # per-band trajectories also feed the consensus watchdog, so an
+    # abort-enabled run collects them even with telemetry off
+    collect = telemetry_enabled() or cfg.abort_on_divergence
     fn = make_admm_mesh_fn(
         mesh, nadmm=nadmm, max_emiter=cfg.max_emiter,
         plain_emiter=max(cfg.max_emiter, 2),
@@ -503,6 +505,31 @@ def _run_distributed_inner(
                 seconds=time.time() - tic,
                 phase_seconds=timer.tile_timings(), **extra,
             )
+        if out.primal_res_band is not None:
+            # consensus watchdog: per-band residual trajectories ->
+            # ratio/trend/diverged (parallel.consensus.consensus_health
+            # via obs.quality.assess_consensus)
+            from sagecal_tpu.obs.quality import (
+                abort_if_diverged, assess_consensus,
+            )
+
+            verdict, reasons, health = assess_consensus(
+                np.asarray(out.primal_res_band),
+                np.asarray(out.dual_res_band),
+            )
+            if elog is not None:
+                elog.emit("consensus_health", tile=t0, verdict=verdict,
+                          reasons=reasons, ratio=health["ratio"],
+                          trend=health["trend"])
+                if verdict == "diverged":
+                    elog.emit("solver_diverged", reasons=reasons,
+                              tile=t0, app="distributed")
+            if verdict != "ok":
+                log(f"tile {t0}: consensus watchdog {verdict} "
+                    f"({', '.join(reasons)})")
+            if cfg.abort_on_divergence:
+                abort_if_diverged(elog, verdict, reasons, tile=t0,
+                                  app="distributed")
         log(
             f"tile {t0}: dual {float(out.dual_res[-1]):.3e} primal "
             f"{float(out.primal_res[-1]):.3e} ({time.time()-tic:.1f}s) "

@@ -171,6 +171,9 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
         nulow=cfg.nulow, nuhigh=cfg.nuhigh, randomize=cfg.randomize,
         use_fused_predict=cfg.use_fused_predict and not cfg.use_f64,
         collect_telemetry=telemetry_enabled(),
+        # quality side outputs feed the watchdog: needed whenever
+        # telemetry records them OR the run must be able to abort
+        collect_quality=telemetry_enabled() or cfg.abort_on_divergence,
     )
     elog = default_event_log(manifest=RunManifest.collect(
         kernel_path="fused" if scfg.use_fused_predict else "xla",
@@ -334,6 +337,31 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
         p = pinit if diverged else jnp.asarray(np.asarray(out.p))
         if diverged:
             log(f"tile {t0}: diverged ({res0:.3e} -> {res1:.3e}), reset")
+
+        # quality watchdog (obs/quality.py): chi^2 attribution + gain
+        # health of this tile's solve -> solve_quality event + gauges,
+        # escalating to quality_degraded / solver_diverged.  The
+        # residual-ratio guard above joins the same verdict so
+        # --abort-on-divergence covers both detectors.
+        from sagecal_tpu.obs.quality import abort_if_diverged, check_and_emit
+
+        q_verdict, q_reasons = "ok", []
+        if out.quality is not None:
+            q_verdict, q_reasons = check_and_emit(
+                elog, out.quality, log=log, tile=t0, app="fullbatch",
+            )
+        if diverged:
+            if q_verdict != "diverged" and elog is not None:
+                elog.emit("solver_diverged",
+                          reasons=[f"residual_ratio:{res0:.3e}->{res1:.3e}"],
+                          tile=t0, app="fullbatch")
+            q_verdict = "diverged"
+            q_reasons = q_reasons + [
+                f"residual_ratio:{res0:.3e}->{res1:.3e}"
+            ]
+        if cfg.abort_on_divergence:
+            abort_if_diverged(elog, q_verdict, q_reasons,
+                              tile=t0, app="fullbatch")
 
         # append solution columns (fullbatch_mode.cpp:595-605)
         jsol = np.asarray(params_to_jones(p)).reshape(M * nchunk_max, N, 2, 2)

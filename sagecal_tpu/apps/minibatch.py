@@ -172,7 +172,13 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                     cbs.append(build_cluster_data(db, clusters, nchunks,
                                                   fdelta=fd,
                                                   shapelets=shapelets))
+                # consensus watchdog bookkeeping: per-round per-band
+                # primal residuals + global dual residual trajectories
+                track = (cfg.verbose or elog is not None
+                         or cfg.abort_on_divergence)
+                pres_traj, dual_traj = [], []
                 for admm in range(cfg.admm_iters):
+                    Z_old = Z
                     zacc = jnp.zeros((M, cfg.npoly, nchunk_max * 8 * N), dtype)
                     for bi in range(len(bands)):
                         BZ = consensus.bz_for_freq(
@@ -199,7 +205,7 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                             Y_bands[bi]
                             + rho[bi][:, None, None] * (p_bands[bi] - BZ1)
                         )
-                    if cfg.verbose or elog is not None:
+                    if track:
                         # per-band scaled primal residuals (the same
                         # normalization the mesh driver logs,
                         # consensus.admm_primal_residual)
@@ -212,14 +218,48 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                             ))
                             for bi in range(len(bands))
                         ]
+                        dres = float(consensus.admm_dual_residual(Z, Z_old))
+                        pres_traj.append(pres_band)
+                        dual_traj.append(dres)
                         if elog is not None:
                             elog.emit(
                                 "admm_round", epoch=epoch, minibatch=mb,
                                 admm_iter=admm, primal_res=pres_band,
+                                dual_res=dres,
                             )
                         if cfg.verbose:
                             log(f"  admm {admm}: primal "
-                                f"{sum(pres_band):.4e}")
+                                f"{sum(pres_band):.4e} dual {dres:.4e}")
+                if pres_traj:
+                    # ADMM watchdog: a band whose primal residual grows
+                    # away from its trajectory minimum (or goes
+                    # non-finite) marks this minibatch's consensus as
+                    # diverged (obs/quality.assess_consensus)
+                    from sagecal_tpu.obs.quality import (
+                        abort_if_diverged, assess_consensus,
+                    )
+
+                    pr = np.asarray(pres_traj)
+                    du = np.tile(np.asarray(dual_traj)[:, None],
+                                 (1, pr.shape[1]))
+                    verdict, reasons, health = assess_consensus(pr, du)
+                    if elog is not None:
+                        elog.emit(
+                            "consensus_health", epoch=epoch, minibatch=mb,
+                            verdict=verdict, reasons=reasons,
+                            ratio=health["ratio"], trend=health["trend"],
+                        )
+                        if verdict == "diverged":
+                            elog.emit("solver_diverged", reasons=reasons,
+                                      epoch=epoch, minibatch=mb,
+                                      app="minibatch")
+                    if verdict != "ok":
+                        log(f"consensus watchdog: {verdict} "
+                            f"({', '.join(reasons)})")
+                    if cfg.abort_on_divergence:
+                        abort_if_diverged(elog, verdict, reasons,
+                                          epoch=epoch, minibatch=mb,
+                                          app="minibatch")
             if elog is not None:
                 elog.emit("minibatch_done", epoch=epoch, minibatch=mb,
                           t0=t0, t1=t1, seconds=time.time() - tic)

@@ -45,7 +45,32 @@ from sagecal_tpu.obs.perf import (  # noqa: F401
     record_memory_watermark,
 )
 
+# obs.quality names resolve lazily (PEP 562): the module needs numpy,
+# and this package root must stay importable without it
+_QUALITY_NAMES = (
+    "DivergenceAbort",
+    "abort_if_diverged",
+    "analyze_events",
+    "assess_consensus",
+    "assess_quality",
+    "check_and_emit",
+    "quality_summary",
+    "quality_to_host",
+    "write_baseline_heatmap",
+    "write_station_heatmap",
+)
+
+
+def __getattr__(name):
+    if name in _QUALITY_NAMES:
+        from sagecal_tpu.obs import quality as _quality
+
+        return getattr(_quality, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
+    *_QUALITY_NAMES,
     "MetricsRegistry",
     "NullRegistry",
     "get_registry",

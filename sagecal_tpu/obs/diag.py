@@ -25,6 +25,14 @@ Subcommands:
   (throughput dropping or bytes/memory rising beyond tolerance fails).
   Exit 1 on any regression or when nothing is comparable.
 
+- ``quality FILE [--out-dir DIR]`` — calibration-quality report from a
+  run's ``solve_quality`` / ``admm_round`` events: per-station and
+  per-baseline chi^2 heatmaps as PPM images, consensus health per tile,
+  and a machine-readable ``quality_report.json``.  Exit 1 when the run
+  diverged (non-finite gains/chi^2, consensus runaway, or a recorded
+  ``solver_diverged`` event); ``--fail-degraded`` also fails on
+  degradation (station outliers, heavy down-weighting).
+
 Runs standalone (``python -m sagecal_tpu.obs.diag ...``) or via the
 ``diag`` subcommand of the main CLI (:mod:`sagecal_tpu.apps.cli`).
 """
@@ -250,6 +258,62 @@ def _cmd_gate(args) -> int:
     return 1 if failures else 0
 
 
+def _cmd_quality(args) -> int:
+    import os
+
+    from sagecal_tpu.obs.quality import (
+        analyze_events,
+        write_baseline_heatmap,
+        write_station_heatmap,
+    )
+
+    evs = read_events(args.file)
+    if not evs:
+        print(f"{args.file}: no events", file=sys.stderr)
+        return 1
+    report = analyze_events(evs, trend_thresh=args.trend_thresh)
+
+    out_dir = args.out_dir or os.path.dirname(os.path.abspath(args.file))
+    os.makedirs(out_dir, exist_ok=True)
+    images = {}
+    if report["station_matrix"] is not None:
+        p = os.path.join(out_dir, "station_chi2.ppm")
+        write_station_heatmap(report["station_matrix"], p)
+        images["station_chi2"] = p
+    if report["baseline_total"] is not None:
+        p = os.path.join(out_dir, "baseline_chi2.ppm")
+        write_baseline_heatmap(report["baseline_total"], p)
+        images["baseline_chi2"] = p
+
+    json_report = {
+        k: v for k, v in report.items()
+        if k not in ("station_matrix", "baseline_total")
+    }
+    json_report["images"] = images
+    # arrays inside solves/consensus entries were already listified by
+    # analyze_events / the event log round-trip
+    rp = os.path.join(out_dir, "quality_report.json")
+    with open(rp, "w", encoding="utf-8") as f:
+        json.dump(json_report, f, indent=2, sort_keys=True, default=float)
+        f.write("\n")
+
+    verdict = ("DIVERGED" if report["diverged"]
+               else "DEGRADED" if report["degraded"] else "OK")
+    print(f"{args.file}: quality {verdict} "
+          f"({report['n_solve_quality_events']} solve_quality events, "
+          f"{len(report['consensus'])} consensus rounds)")
+    for r in report["reasons"]:
+        print(f"  {r}")
+    for name, p in images.items():
+        print(f"  {name} -> {p}")
+    print(f"  report -> {rp}")
+    if report["diverged"]:
+        return 1
+    if args.fail_degraded and report["degraded"]:
+        return 1
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="sagecal-tpu diag",
@@ -295,6 +359,22 @@ def build_parser() -> argparse.ArgumentParser:
     gp.add_argument("--strict", action="store_true",
                     help="compare even across a platform mismatch")
     gp.set_defaults(fn=_cmd_gate)
+
+    qp = sub.add_parser(
+        "quality",
+        help="calibration-quality report + chi^2 heatmaps from an event log",
+    )
+    qp.add_argument("file", help="JSONL event log of a telemetry run")
+    qp.add_argument("--out-dir", default=None,
+                    help="directory for the PPM heatmaps + JSON report "
+                         "(default: alongside the event log)")
+    qp.add_argument("--trend-thresh", type=float, default=2.0,
+                    help="ADMM primal-residual growth treated as "
+                         "divergence (default 2.0)")
+    qp.add_argument("--fail-degraded", action="store_true",
+                    help="exit non-zero on degradation too, not just "
+                         "divergence")
+    qp.set_defaults(fn=_cmd_quality)
     return ap
 
 

@@ -1,0 +1,390 @@
+"""Host-side calibration-quality analysis: reports, watchdog, heatmaps.
+
+The device half of this layer (:mod:`sagecal_tpu.ops.quality`) returns
+fixed-shape :class:`~sagecal_tpu.ops.quality.SolveQuality` pytrees from
+inside the jitted solves.  This module is everything that happens AFTER
+the solve returns on the host:
+
+- :func:`quality_to_host` — materialize a (possibly cluster-stacked)
+  ``SolveQuality`` into plain numpy arrays keyed by field name.
+- :func:`assess_quality` — the watchdog verdict: ``"ok"`` /
+  ``"degraded"`` / ``"diverged"`` with human-readable reasons.  Divergence
+  means the solution is unusable (non-finite gains or chi^2); degradation
+  means it is suspect (a station's chi^2 is a large outlier, the robust
+  weights flattened most of the data).
+- :func:`check_and_emit` — the one-call app hook: emit a
+  ``solve_quality`` event, update registry gauges, and escalate to a
+  ``quality_degraded`` / ``solver_diverged`` event when warranted.
+- :func:`assess_consensus` — the ADMM side of the watchdog, reading the
+  per-band residual trajectories that distributed/minibatch runs attach
+  to their ``admm_round`` events.
+- PPM heatmap writers + :func:`analyze_events` backing ``diag quality``.
+
+Nothing here imports jax; everything operates on materialized numpy
+arrays (the ``obs`` package contract — usable before backend selection
+and on hosts without a device).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sagecal_tpu.obs.registry import get_registry
+from sagecal_tpu.utils.ppm import write_ppm
+
+# A station whose chi^2 exceeds this multiple of the median (over
+# stations with data) is flagged as an outlier — the classic "one bad
+# station" signature the reference finds by eyeballing residual images.
+CHI2_OUTLIER_RATIO = 25.0
+# Degradation threshold on the effectively down-weighted fraction: when
+# the robust weights have flattened more than this share of the
+# unflagged data, the Gaussian interpretation of chi^2 is gone.
+DOWNWEIGHT_WARN_FRAC = 0.5
+# ADMM primal-residual growth (final / trajectory-min) beyond this is
+# divergence, matching parallel.consensus.consensus_health's default.
+CONSENSUS_TREND_THRESH = 2.0
+
+
+class DivergenceAbort(RuntimeError):
+    """Raised by apps running with ``abort_on_divergence`` when the
+    watchdog reports a diverged solve (after the structured
+    ``run_aborted`` event is emitted)."""
+
+
+def _np(x) -> Optional[np.ndarray]:
+    return None if x is None else np.asarray(x)
+
+
+def quality_to_host(q) -> dict:
+    """Materialize a ``SolveQuality`` (or the dict sagefit returns, or an
+    already-converted dict) into ``{field: numpy array}`` with ``None``
+    fields dropped.  Stacked leading axes (per-cluster quality out of the
+    SAGE EM scan) are preserved."""
+    if q is None:
+        return {}
+    if isinstance(q, dict):
+        # sagefit's {"em": per-cluster SolveQuality, "final": SolveQuality}
+        return {k: quality_to_host(v) for k, v in q.items() if v is not None}
+    d = q._asdict() if hasattr(q, "_asdict") else dict(q)
+    return {k: _np(v) for k, v in d.items() if v is not None}
+
+
+def _total_chi2(qd: dict) -> Optional[float]:
+    ch = qd.get("chi2_chunk")
+    if ch is None:
+        return None
+    return float(np.sum(ch))
+
+
+def _station_chi2(qd: dict) -> Optional[np.ndarray]:
+    st = qd.get("chi2_station")
+    if st is None:
+        return None
+    st = np.asarray(st, float)
+    # per-cluster stacks reduce to total attribution per station
+    return st.reshape(-1, st.shape[-1]).sum(axis=0) if st.ndim > 1 else st
+
+
+def assess_quality(
+    qd: dict,
+    chi2_outlier_ratio: float = CHI2_OUTLIER_RATIO,
+    downweight_warn: float = DOWNWEIGHT_WARN_FRAC,
+) -> Tuple[str, List[str]]:
+    """Watchdog verdict for one solve's host-side quality dict.
+
+    Returns ``(verdict, reasons)`` with verdict one of ``"ok"``,
+    ``"degraded"``, ``"diverged"``.  Accepts the output of
+    :func:`quality_to_host` on any solver's quality (missing fields are
+    simply not checked); sagefit's ``{"em": ..., "final": ...}`` bundles
+    are assessed on the ``final`` entry.
+    """
+    if "final" in qd or "em" in qd:
+        qd = qd.get("final", qd.get("em", {}))
+    reasons: List[str] = []
+    diverged = False
+
+    nf = qd.get("nonfinite_count")
+    if nf is not None and float(np.sum(nf)) > 0:
+        diverged = True
+        reasons.append(f"nonfinite_gains:{int(np.sum(nf))}")
+
+    st = _station_chi2(qd)
+    if st is not None:
+        if not np.all(np.isfinite(st)):
+            diverged = True
+            reasons.append("nonfinite_chi2")
+        else:
+            active = st[st > 0]
+            med = float(np.median(active)) if active.size else 0.0
+            if med > 0:
+                bad = np.nonzero(st > chi2_outlier_ratio * med)[0]
+                if bad.size:
+                    reasons.append(
+                        "station_chi2_outlier:"
+                        + ",".join(str(int(b)) for b in bad)
+                    )
+
+    dw = qd.get("downweighted_frac")
+    if dw is not None and float(np.max(dw)) > downweight_warn:
+        reasons.append(f"downweighted_frac:{float(np.max(dw)):.3f}")
+
+    if diverged:
+        return "diverged", reasons
+    return ("degraded", reasons) if reasons else ("ok", reasons)
+
+
+def assess_consensus(
+    primal_res_band,
+    dual_res_band,
+    trend_thresh: float = CONSENSUS_TREND_THRESH,
+) -> Tuple[str, List[str], dict]:
+    """ADMM watchdog: per-band health from the (nadmm, Nf) residual
+    trajectories (the arrays distributed runs attach to ``admm_round``
+    events).  Returns ``(verdict, reasons, health)`` where ``health`` has
+    the per-band ``ratio`` / ``trend`` / ``diverged`` arrays of
+    :func:`sagecal_tpu.parallel.consensus.consensus_health` (the shared
+    definition — imported lazily so this module stays jax-free until an
+    ADMM run actually uses it)."""
+    from sagecal_tpu.parallel.consensus import consensus_health
+
+    pr = np.atleast_2d(np.asarray(primal_res_band, float))
+    du = np.atleast_2d(np.asarray(dual_res_band, float))
+    ratio, trend, diverged = (
+        np.asarray(x) for x in consensus_health(pr, du, trend_thresh)
+    )
+    health = {"ratio": ratio, "trend": trend, "diverged": diverged}
+    reasons: List[str] = []
+    bad = np.nonzero(diverged)[0]
+    if bad.size:
+        reasons.append(
+            "consensus_diverged_bands:" + ",".join(str(int(b)) for b in bad)
+        )
+        return "diverged", reasons, health
+    return "ok", reasons, health
+
+
+def quality_summary(qd: dict) -> dict:
+    """Compact JSON-ready summary of one solve's quality dict (full
+    per-station / per-baseline arrays ride along for the heatmaps)."""
+    if "final" in qd or "em" in qd:
+        qd = qd.get("final", qd.get("em", {}))
+    out: dict = {}
+    tot = _total_chi2(qd)
+    if tot is not None:
+        out["chi2_total"] = tot
+    st = _station_chi2(qd)
+    if st is not None:
+        out["chi2_station"] = st
+        if st.size and np.all(np.isfinite(st)):
+            out["chi2_station_worst"] = int(np.argmax(st))
+    for k in ("chi2_baseline", "nonfinite_count", "nu", "weight_hist",
+              "downweighted_frac", "flagged_frac", "station_amp",
+              "station_amp_spread", "station_phase_spread",
+              "identity_departure"):
+        if qd.get(k) is not None:
+            out[k] = qd[k]
+    return out
+
+
+def check_and_emit(
+    elog,
+    quality,
+    log=None,
+    **context,
+) -> Tuple[str, List[str]]:
+    """The app-side hook: assess one solve's quality, emit the
+    ``solve_quality`` event (plus ``quality_degraded`` /
+    ``solver_diverged`` on escalation), and refresh registry gauges.
+
+    ``elog`` may be None (telemetry off) — the assessment still runs so
+    the caller can abort on divergence either way.  ``context`` fields
+    (tile, cluster, app, ...) are copied onto every emitted event.
+    Returns ``(verdict, reasons)``.
+    """
+    qd = quality_to_host(quality)
+    verdict, reasons = assess_quality(qd)
+    summary = quality_summary(qd)
+
+    reg = get_registry()
+    if "chi2_total" in summary:
+        reg.gauge_set("sagecal_quality_chi2_total", summary["chi2_total"],
+                      help="total chi^2 of the latest solve")
+    nf = summary.get("nonfinite_count")
+    if nf is not None:
+        reg.gauge_set("sagecal_quality_nonfinite_params",
+                      float(np.sum(nf)),
+                      help="non-finite gain parameters in the latest solve")
+    dw = summary.get("downweighted_frac")
+    if dw is not None:
+        reg.gauge_set("sagecal_quality_downweighted_frac",
+                      float(np.max(dw)),
+                      help="fraction of unflagged data down-weighted "
+                           "below 0.5 by the robust weights")
+    if verdict != "ok":
+        reg.counter_inc("sagecal_quality_watchdog_total",
+                        help="watchdog escalations", verdict=verdict)
+
+    if elog is not None:
+        elog.emit("solve_quality", verdict=verdict, reasons=reasons,
+                  **summary, **context)
+        if verdict == "diverged":
+            elog.emit("solver_diverged", reasons=reasons, **context)
+        elif verdict == "degraded":
+            elog.emit("quality_degraded", reasons=reasons, **context)
+    if log is not None and verdict != "ok":
+        log(f"quality watchdog: {verdict} ({', '.join(reasons)})")
+    return verdict, reasons
+
+
+def abort_if_diverged(elog, verdict: str, reasons: Sequence[str],
+                      **context) -> None:
+    """The ``--abort-on-divergence`` exit path: emit a structured
+    ``run_aborted`` event, close the log, and raise
+    :class:`DivergenceAbort`."""
+    if verdict != "diverged":
+        return
+    if elog is not None:
+        elog.emit("run_aborted", reason="solver_diverged",
+                  details=list(reasons), **context)
+        elog.close()
+    raise DivergenceAbort(
+        "solver diverged (" + ", ".join(reasons) + "); aborting "
+        "(abort_on_divergence)"
+    )
+
+
+# ---------------------------------------------------------------- heatmaps
+
+
+def _lognorm(a: np.ndarray) -> np.ndarray:
+    """Non-negative array -> [0,1] on a log1p scale (chi^2 spans orders
+    of magnitude; linear scaling would show only the worst cell).
+    Non-finite cells render hot (1.0)."""
+    a = np.asarray(a, float)
+    bad = ~np.isfinite(a)
+    a = np.where(bad, 0.0, np.maximum(a, 0.0))
+    v = np.log1p(a)
+    top = float(v.max()) if v.size else 0.0
+    out = v / top if top > 0 else np.zeros_like(v)
+    return np.where(bad, 1.0, out)
+
+
+def _upscale(img: np.ndarray, min_px: int = 256) -> np.ndarray:
+    """Integer-replicate a small matrix so each cell is a visible block
+    (PPM viewers do no interpolation)."""
+    h, w = img.shape
+    s = max(1, int(np.ceil(min_px / max(h, w, 1))))
+    return np.kron(img, np.ones((s, s))) if s > 1 else img
+
+
+def write_station_heatmap(chi2_station, path: str, min_px: int = 256):
+    """Per-station chi^2 heatmap: rows = solves/tiles (or clusters),
+    columns = stations, log-normalized blue->green->red."""
+    a = np.atleast_2d(np.asarray(chi2_station, float))
+    write_ppm(path, _upscale(_lognorm(a), min_px))
+
+
+def write_baseline_heatmap(chi2_baseline, path: str, min_px: int = 256):
+    """Per-baseline chi^2 heatmap: the (N, N) attribution symmetrized
+    (rows scatter to (p, q) only), log-normalized."""
+    a = np.asarray(chi2_baseline, float)
+    a = a + a.T
+    write_ppm(path, _upscale(_lognorm(a), min_px))
+
+
+# ------------------------------------------------------- event-log analysis
+
+
+def analyze_events(events: Sequence[dict],
+                   trend_thresh: float = CONSENSUS_TREND_THRESH) -> dict:
+    """Build the ``diag quality`` report from a run's event list.
+
+    Reads every ``solve_quality`` event (re-assessing each with the
+    current thresholds) and every ``admm_round`` event carrying per-band
+    residual trajectories (assessed with :func:`assess_consensus`).  Any
+    ``solver_diverged`` / ``run_aborted`` event recorded by the run
+    itself also marks the report diverged.  Returns a dict with
+    ``diverged`` / ``degraded`` flags, per-solve summaries, consensus
+    health, and the stacked arrays the heatmap writers want
+    (``station_matrix`` rows = solves, ``baseline_total``)."""
+    solves: List[dict] = []
+    station_rows: List[np.ndarray] = []
+    baseline_total: Optional[np.ndarray] = None
+    consensus: List[dict] = []
+    diverged = False
+    degraded = False
+    reasons: List[str] = []
+
+    for e in events:
+        t = e.get("type")
+        if t in ("solver_diverged", "run_aborted"):
+            diverged = True
+            reasons.append(
+                f"{t}:" + ",".join(map(str, e.get("reasons")
+                                       or e.get("details") or []))
+            )
+        elif t == "solve_quality":
+            qd = {k: np.asarray(v) for k, v in e.items()
+                  if k in ("chi2_station", "chi2_baseline", "chi2_chunk",
+                           "nonfinite_count", "downweighted_frac")
+                  and v is not None}
+            verdict, why = assess_quality(qd)
+            rec = {k: e.get(k) for k in ("tile", "cluster", "epoch")
+                   if k in e}
+            rec.update(verdict=verdict, reasons=why,
+                       chi2_total=e.get("chi2_total"),
+                       nu=e.get("nu"))
+            solves.append(rec)
+            if verdict == "diverged":
+                diverged = True
+                reasons.extend(why)
+            elif verdict == "degraded":
+                degraded = True
+                reasons.extend(why)
+            st = _station_chi2(qd)
+            if st is not None:
+                station_rows.append(st)
+            bl = qd.get("chi2_baseline")
+            if bl is not None:
+                bl = np.asarray(bl, float)
+                bl = bl.reshape((-1,) + bl.shape[-2:]).sum(axis=0)
+                baseline_total = (
+                    bl if baseline_total is None else baseline_total + bl
+                )
+        elif t == "consensus_health":
+            # minibatch runs assess in-process and record the verdict
+            rec = {k: e.get(k) for k in ("epoch", "minibatch", "tile",
+                                         "verdict", "reasons", "ratio",
+                                         "trend") if k in e}
+            consensus.append(rec)
+            if e.get("verdict") == "diverged":
+                diverged = True
+                reasons.extend(e.get("reasons") or ["consensus_diverged"])
+        elif t == "admm_round" and e.get("primal_res_band") is not None:
+            verdict, why, health = assess_consensus(
+                e["primal_res_band"], e["dual_res_band"], trend_thresh
+            )
+            consensus.append({
+                "tile": e.get("tile"), "verdict": verdict,
+                "reasons": why,
+                "ratio": health["ratio"].tolist(),
+                "trend": health["trend"].tolist(),
+            })
+            if verdict == "diverged":
+                diverged = True
+                reasons.extend(why)
+
+    return {
+        "diverged": diverged,
+        "degraded": degraded,
+        "reasons": reasons,
+        "n_solve_quality_events": len(solves),
+        "solves": solves,
+        "consensus": consensus,
+        "station_matrix": (
+            np.stack(station_rows) if station_rows else None
+        ),
+        "baseline_total": baseline_total,
+    }

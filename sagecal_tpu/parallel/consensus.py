@@ -160,6 +160,43 @@ def admm_dual_residual(Z_new, Z_old):
     return jnp.linalg.norm(d) / jnp.sqrt(d.shape[0])
 
 
+def consensus_health(
+    primal_res_band,
+    dual_res_band,
+    trend_thresh: float = 2.0,
+    eps: float = 1e-30,
+):
+    """Per-band ADMM consensus health from residual trajectories.
+
+    ``primal_res_band``/``dual_res_band``: (nadmm, Nf) per-round,
+    per-band residuals (:class:`sagecal_tpu.parallel.mesh.AdmmResult`
+    with ``collect_trace``).  Returns ``(ratio (Nf,), trend (Nf,),
+    diverged (Nf,) bool)``:
+
+    - ``ratio``: final primal/dual residual ratio — the standard ADMM
+      balance diagnostic (Boyd §3.4.1; the reference's master prints the
+      two norms side by side, sagecal_master.cpp:869-885).  Large values
+      mean rho is too small for that band (consensus not enforced),
+      tiny values mean rho dominates the data term.
+    - ``trend``: final primal residual over the trajectory minimum —
+      > 1 means the band moved AWAY from consensus after its best round.
+    - ``diverged``: non-finite residuals anywhere in the trajectory, or
+      ``trend > trend_thresh`` (sustained growth, not a one-round blip).
+
+    Pure array math (works on numpy or jax inputs) so the apps' host-side
+    watchdog and on-device callers share one definition.
+    """
+    pr = jnp.asarray(primal_res_band)
+    du = jnp.asarray(dual_res_band)
+    ratio = pr[-1] / jnp.maximum(du[-1], eps)
+    trend = pr[-1] / jnp.maximum(jnp.min(pr, axis=0), eps)
+    nonfinite = ~(
+        jnp.all(jnp.isfinite(pr), axis=0) & jnp.all(jnp.isfinite(du), axis=0)
+    )
+    diverged = nonfinite | (trend > trend_thresh)
+    return ratio, trend, diverged
+
+
 def admm_primal_residual(J_flat, BZ_flat):
     """Per-real-parameter primal residual ||J - BZ||/sqrt(size): how far
     one band's local solution sits from its consensus target (the

@@ -157,6 +157,7 @@ def lbfgs_fit(
     memory: Optional[LBFGSMemory] = None,
     minibatch: bool = False,
     collect_trace: bool = False,
+    vg_fn: Optional[Callable] = None,
 ) -> LBFGSResult:
     """Generic LBFGS fit (``lbfgs_fit``, Dirac.h:175 / lbfgs.c:479,717).
 
@@ -165,6 +166,14 @@ def lbfgs_fit(
     iteration counts, and gradient-variance statistics persist.  With
     ``minibatch=False`` and no memory this is the full-batch fit (fresh
     memory, alphabar=1).
+
+    ``vg_fn(p) -> (cost, grad)`` overrides the default fused
+    value-and-grad.  Callers whose gradient CANNOT be obtained by
+    differentiating ``cost_fn`` must pass it: under ``shard_map`` a
+    ``psum``'d cost transposes to a device-local cotangent, so
+    ``value_and_grad(cost_fn)`` yields each device only its shard's
+    gradient — the correct global gradient is
+    ``psum(value_and_grad(local_cost)(p))`` (solvers/sharded.py).
     """
     n = p0.shape[0]
     # fused value+gradient: the reverse pass shares its forward with the
@@ -172,11 +181,12 @@ def lbfgs_fit(
     # the loop then saves the cost_fn(x) re-evaluation Armijo would
     # otherwise make every iteration (one full pass over the coherency
     # stack on the calibration cost)
-    if grad_fn is None:
-        vg_fn = jax.value_and_grad(cost_fn)
-    else:
-        def vg_fn(x):
-            return cost_fn(x), grad_fn(x)
+    if vg_fn is None:
+        if grad_fn is None:
+            vg_fn = jax.value_and_grad(cost_fn)
+        else:
+            def vg_fn(x):
+                return cost_fn(x), grad_fn(x)
     fresh = memory is None
     if fresh:
         memory = LBFGSMemory.init(n, M, p0.dtype)
@@ -329,4 +339,4 @@ from sagecal_tpu.obs.perf import instrumented_jit  # noqa: E402
 lbfgs_fit_jit = instrumented_jit(
     lbfgs_fit, name="lbfgs_fit",
     static_argnames=("cost_fn", "grad_fn", "itmax", "M", "minibatch",
-                     "collect_trace"))
+                     "collect_trace", "vg_fn"))

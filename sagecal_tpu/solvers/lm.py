@@ -31,6 +31,7 @@ from flax import struct
 
 from sagecal_tpu.core.types import corrupt_flat, params_to_jones, reals_of_flat
 from sagecal_tpu.obs.records import init_trace, write_trace
+from sagecal_tpu.ops.quality import SolveQuality, residual_quality
 from sagecal_tpu.utils.precision import true_f32
 
 # Row-block size for the Jacobian-assembly scan: bounds the per-block
@@ -57,6 +58,8 @@ class LMResult(NamedTuple):
     # per-iteration IterTrace (obs.records) when collect_trace=True, else
     # None — an empty pytree, so the jitted output signature is unchanged
     trace: Optional[tuple] = None
+    # SolveQuality (ops.quality) when collect_quality=True, same contract
+    quality: Optional[SolveQuality] = None
 
 
 def _residual_flat(p_all, coh, vis, mask, ant_p, ant_q, chunk_map, sqrt_w):
@@ -222,8 +225,16 @@ def lm_solve(
     admm_bz: Optional[jax.Array] = None,
     admm_rho: Optional[jax.Array] = None,
     collect_trace: bool = False,
+    collect_quality: bool = False,
 ) -> LMResult:
     """Solve min_p sum_rows ||vis - J_p C J_q^H||^2 per hybrid chunk.
+
+    ``collect_quality``: statically enables the fixed-shape quality side
+    outputs (ops/quality.py): chi^2 attribution of the final residual
+    per station / baseline / chunk plus gain health of the final p.
+    Attribution is of the DATA term only — in ADMM-augmented solves the
+    consensus terms are excluded, so ``quality.chi2_chunk`` equals the
+    reported ``cost`` exactly only for plain solves.
 
     ``itmax_dynamic``: optional traced iteration bound (the SAGE driver's
     weighted per-cluster iteration allocation, lmfit.c:859-882);
@@ -340,7 +351,16 @@ def lm_solve(
         cond, body,
         match_vma((jnp.asarray(0), p0, cost0, mu0, nu0, done0, trace0), p0),
     )
-    return LMResult(p=p, cost0=cost0, cost=cost, iterations=it, trace=trace)
+    quality = None
+    if collect_quality:
+        e1 = _residual_flat(
+            p, coh, vis, mask, ant_p, ant_q, chunk_map, sqrt_weights
+        )
+        quality = residual_quality(
+            e1, p, ant_p, ant_q, chunk_map, nchunk
+        )
+    return LMResult(p=p, cost0=cost0, cost=cost, iterations=it, trace=trace,
+                    quality=quality)
 
 
 @true_f32
@@ -351,6 +371,7 @@ def os_lm_solve(
     nsubsets: int = 4,
     key: Optional[jax.Array] = None,
     collect_trace: bool = False,
+    collect_quality: bool = False,
 ) -> LMResult:
     """Ordered-subsets accelerated LM (``oslevmar_der_single_nocuda``,
     Dirac.h:907): each outer iteration runs one LM pass on a random subset
@@ -394,8 +415,20 @@ def os_lm_solve(
     final_cost = _cost_only(
         p, coh, vis, mask, ant_p, ant_q, chunk_map, p0.shape[0], sqrt_weights
     )
+    quality = None
+    if collect_quality:
+        # attribution of the FULL-mask residual at the final p (each
+        # subset pass only ever saw its own rows; quality reports the
+        # solver's final objective over all of them)
+        e1 = _residual_flat(
+            p, coh, vis, mask, ant_p, ant_q, chunk_map, sqrt_weights
+        )
+        quality = residual_quality(
+            e1, p, ant_p, ant_q, chunk_map, p0.shape[0]
+        )
     return LMResult(p=p, cost0=cost0, cost=final_cost,
-                    iterations=jnp.asarray(config.itmax), trace=trace)
+                    iterations=jnp.asarray(config.itmax), trace=trace,
+                    quality=quality)
 
 
 # Jitted module entries (obs/perf.py): inside the packed SAGE solve
@@ -407,7 +440,8 @@ def os_lm_solve(
 from sagecal_tpu.obs.perf import instrumented_jit  # noqa: E402
 
 lm_solve_jit = instrumented_jit(
-    lm_solve, name="lm_solve", static_argnames=("collect_trace",))
+    lm_solve, name="lm_solve",
+    static_argnames=("collect_trace", "collect_quality"))
 os_lm_solve_jit = instrumented_jit(
     os_lm_solve, name="os_lm_solve",
-    static_argnames=("nsubsets", "collect_trace"))
+    static_argnames=("nsubsets", "collect_trace", "collect_quality"))

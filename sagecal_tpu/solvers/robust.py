@@ -92,6 +92,7 @@ def robust_lm_solve(
     em_iters: int = 3,
     config: LMConfig = LMConfig(),
     collect_trace: bool = False,
+    collect_quality: bool = False,
 ):
     """Robust LM: EM over (weights, nu) wrapping weighted LM solves
     (``rlevmar_der_single_nocuda``, robustlm.c; Dirac.h:744).
@@ -100,6 +101,11 @@ def robust_lm_solve(
     stacks the EM stages in front: ``(em_iters + 1, itmax, nchunk)`` per
     field (final weighted solve last), with the trace's ``nu`` field set
     to the Student's-t nu in effect during each stage.
+
+    ``collect_quality`` additionally fills the result's quality slot
+    (ops/quality.py): the final weighted solve's chi^2 attribution and
+    gain health, enriched with the converged nu and Student's-t weight
+    statistics (histogram, down-weighted and flagged fractions).
     """
     mask8 = mask[..., None, :]  # broadcasts over the (F, 8, rows) residual
 
@@ -136,7 +142,16 @@ def robust_lm_solve(
     res = lm_solve(
         vis, coh, mask, ant_p, ant_q, chunk_map, p, config,
         sqrt_weights=sqrt_w, collect_trace=collect_trace,
+        collect_quality=collect_quality,
     )
+    if collect_quality:
+        from sagecal_tpu.ops.quality import weight_stats
+
+        hist, down, flag = weight_stats(sqrt_w, nu, mask8)
+        res = res._replace(quality=res.quality._replace(
+            nu=jnp.asarray(nu, p0.dtype), weight_hist=hist,
+            downweighted_frac=down, flagged_frac=flag,
+        ))
     if collect_trace:
         _, em_traces = ys  # IterTrace stacked (em_iters, itmax, ...)
         final_tr = res.trace._replace(
@@ -167,4 +182,4 @@ from sagecal_tpu.obs.perf import instrumented_jit  # noqa: E402
 
 robust_lm_solve_jit = instrumented_jit(
     robust_lm_solve, name="robust_lm_solve",
-    static_argnames=("em_iters", "collect_trace"))
+    static_argnames=("em_iters", "collect_trace", "collect_quality"))

@@ -62,6 +62,8 @@ class RTRResult(NamedTuple):
     # per-iteration IterTrace (obs.records) when collect_trace=True, else
     # None — an empty pytree, so the jitted output signature is unchanged
     trace: Optional[tuple] = None
+    # SolveQuality (ops.quality) when collect_quality=True, same contract
+    quality: Optional[tuple] = None
 
 
 # ---------------------------------------------------------------------------
@@ -480,6 +482,26 @@ def _nsd_single(
 # public, chunk-batched entry points
 # ---------------------------------------------------------------------------
 
+def _quality_of(p, vis, coh, mask, ant_p, ant_q, chunk_map,
+                sqrt_w=None, nu=None):
+    """Quality bundle (ops/quality.py) at the final solution ``p``.
+
+    Uses the LM residual path (lm._residual_flat) — for rows of chunk c
+    it evaluates the same J_p C J_q^H model with chunk c's parameters the
+    per-lane RTR cost uses, so ``chi2_chunk`` equals the solver's final
+    per-chunk DATA cost exactly.  ADMM consensus terms are excluded
+    (``RTRResult.cost`` includes them when ``admm_*`` is given)."""
+    from sagecal_tpu.ops.quality import residual_quality
+    from sagecal_tpu.solvers.lm import _residual_flat
+
+    e = _residual_flat(p, coh, vis, mask, ant_p, ant_q, chunk_map, sqrt_w)
+    return residual_quality(
+        e, p, ant_p, ant_q, chunk_map, p.shape[0],
+        nu=nu, sqrt_w=sqrt_w, mask8=mask[..., None, :],
+        weight_dof=2.0,  # RTR robust weights are (nu+2)/(nu+e^2)
+    )
+
+
 def _chunked(solver):
     def run(
         vis, coh, mask, ant_p, ant_q, chunk_map, p0, *args,
@@ -531,6 +553,7 @@ def rtr_solve(
     itmax_dynamic=None,
     admm_y=None, admm_bz=None, admm_rho=None,
     collect_trace: bool = False,
+    collect_quality: bool = False,
 ) -> RTRResult:
     """Batched-over-chunks RTR solve (``rtr_solve_nocuda``, Dirac.h:1132).
 
@@ -540,13 +563,20 @@ def rtr_solve(
     SAGE driver's weighted allocation).  ``admm_y/admm_bz`` (nchunk, 8N)
     + scalar ``admm_rho`` switch on the consensus-augmented cost
     (``rtr_solve_nocuda_admm``/``..._robust_admm``, decl
-    Dirac.h:1182-1195).
+    Dirac.h:1182-1195).  ``collect_quality`` statically enables the
+    fixed-shape quality side outputs (:func:`_quality_of`; data term
+    only under ADMM).
     """
-    return _chunked(_rtr_single)(
+    out = _chunked(_rtr_single)(
         vis, coh, mask, ant_p, ant_q, chunk_map, p0, config, sqrt_weights,
         itmax_dynamic, admm_y=admm_y, admm_bz=admm_bz, admm_rho=admm_rho,
         collect_trace=collect_trace,
     )
+    if collect_quality:
+        out = out._replace(quality=_quality_of(
+            out.p, vis, coh, mask, ant_p, ant_q, chunk_map,
+            sqrt_w=sqrt_weights))
+    return out
 
 
 @true_f32
@@ -557,15 +587,22 @@ def nsd_solve(
     itmax_dynamic=None,
     admm_y=None, admm_bz=None, admm_rho=None,
     collect_trace: bool = False,
+    collect_quality: bool = False,
 ) -> RTRResult:
     """Batched Nesterov steepest descent (``nsd_solve_nocuda_robust``,
     Dirac.h:1166); ADMM-augmented when ``admm_y/admm_bz/admm_rho`` given
-    (``nsd_solve_nocuda_robust_admm``, decl Dirac.h:1207-1224)."""
-    return _chunked(_nsd_single)(
+    (``nsd_solve_nocuda_robust_admm``, decl Dirac.h:1207-1224).
+    ``collect_quality`` as in :func:`rtr_solve`."""
+    out = _chunked(_nsd_single)(
         vis, coh, mask, ant_p, ant_q, chunk_map, p0, itmax, sqrt_weights,
         itmax_dynamic, admm_y=admm_y, admm_bz=admm_bz, admm_rho=admm_rho,
         collect_trace=collect_trace,
     )
+    if collect_quality:
+        out = out._replace(quality=_quality_of(
+            out.p, vis, coh, mask, ant_p, ant_q, chunk_map,
+            sqrt_w=sqrt_weights))
+    return out
 
 
 def _robust_weights_and_nu(
@@ -602,6 +639,7 @@ def rtr_solve_robust(
     itmax_dynamic=None,
     admm_y=None, admm_bz=None, admm_rho=None,
     collect_trace: bool = False,
+    collect_quality: bool = False,
 ):
     """Student's-t EM wrapping RTR (``rtr_solve_nocuda_robust``,
     Dirac.h:1145): E-step per-baseline weights (see
@@ -610,7 +648,13 @@ def rtr_solve_robust(
     passes, lmfit.c:940-947).  With ``admm_*`` given this is
     ``rtr_solve_nocuda_robust_admm`` (rtr_solve_robust_admm.c:1427),
     the reference MPI slave's default local solver.
-    Returns (RTRResult, nu)."""
+    Returns (RTRResult, nu).
+
+    ``collect_quality`` fills the result's quality slot with the chi^2
+    attribution and weight statistics of the FINAL post-loop weight
+    re-estimate (the same weights the returned nu is estimated from) —
+    the weighted objective at the converged solution, not the last EM
+    stage's stale-weight cost."""
 
     def em(carry, _):
         p, nu = carry
@@ -642,10 +686,16 @@ def rtr_solve_robust(
     trace = ys[2] if collect_trace else None  # (em_iters, itmax, nchunk)
     # re-estimate nu from the FINAL solution (the reference updates the
     # weights/nu once more after the loop, rtr_solve_robust.c:1625)
-    _, nu = _robust_weights_and_nu(
+    sqrt_w_f, nu = _robust_weights_and_nu(
         vis, coh, mask, ant_p, ant_q, chunk_map, p, nu, nulow, nuhigh
     )
-    return RTRResult(p=p, cost0=c0s[0], cost=c1s[-1], trace=trace), nu
+    quality = None
+    if collect_quality:
+        quality = _quality_of(
+            p, vis, coh, mask, ant_p, ant_q, chunk_map,
+            sqrt_w=sqrt_w_f, nu=nu)
+    return RTRResult(p=p, cost0=c0s[0], cost=c1s[-1], trace=trace,
+                     quality=quality), nu
 
 
 @true_f32
@@ -657,13 +707,15 @@ def nsd_solve_robust(
     itmax_dynamic=None,
     admm_y=None, admm_bz=None, admm_rho=None,
     collect_trace: bool = False,
+    collect_quality: bool = False,
 ):
     """Robust Nesterov descent (``nsd_solve_nocuda_robust``,
     rtr_solve_robust.c:1878): the same Student's-t EM around
     :func:`nsd_solve`, with nu re-estimated from the residual after each
     solve (rtr_solve_robust.c:2104-2105).  With ``admm_*`` given this is
     the NSD-ADMM local solver (``nsd_solve_nocuda_robust_admm``, decl
-    Dirac.h:1207).  Returns (RTRResult, nu)."""
+    Dirac.h:1207).  Returns (RTRResult, nu).  ``collect_quality`` as in
+    :func:`rtr_solve_robust` (final-weight attribution)."""
 
     def em(carry, _):
         p, nu = carry
@@ -694,20 +746,27 @@ def nsd_solve_robust(
     c0s, c1s = ys[0], ys[1]
     trace = ys[2] if collect_trace else None  # (em_iters, itmax, nchunk)
     # final-solution nu re-estimate (rtr_solve_robust.c:2104)
-    _, nu = _robust_weights_and_nu(
+    sqrt_w_f, nu = _robust_weights_and_nu(
         vis, coh, mask, ant_p, ant_q, chunk_map, p, nu, nulow, nuhigh
     )
-    return RTRResult(p=p, cost0=c0s[0], cost=c1s[-1], trace=trace), nu
+    quality = None
+    if collect_quality:
+        quality = _quality_of(
+            p, vis, coh, mask, ant_p, ant_q, chunk_map,
+            sqrt_w=sqrt_w_f, nu=nu)
+    return RTRResult(p=p, cost0=c0s[0], cost=c1s[-1], trace=trace,
+                     quality=quality), nu
 
 
 # jitted module entries with compile/recompile telemetry (obs/perf.py)
 from sagecal_tpu.obs.perf import instrumented_jit  # noqa: E402
 
 rtr_solve_jit = instrumented_jit(
-    rtr_solve, name="rtr_solve", static_argnames=("collect_trace",))
+    rtr_solve, name="rtr_solve",
+    static_argnames=("collect_trace", "collect_quality"))
 nsd_solve_jit = instrumented_jit(
     nsd_solve, name="nsd_solve",
-    static_argnames=("itmax", "collect_trace"))
+    static_argnames=("itmax", "collect_trace", "collect_quality"))
 rtr_solve_robust_jit = instrumented_jit(
     rtr_solve_robust, name="rtr_solve_robust",
-    static_argnames=("em_iters", "collect_trace"))
+    static_argnames=("em_iters", "collect_trace", "collect_quality"))

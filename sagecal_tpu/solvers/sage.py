@@ -97,6 +97,12 @@ class SageConfig:
     # SageResult.telemetry.  Static: off builds the exact same jaxpr as
     # before (telemetry slots are None = empty pytrees).
     collect_telemetry: bool = struct.field(pytree_node=False, default=False)
+    # Collect fixed-shape solution-quality side outputs (ops/quality.py):
+    # per-cluster SolveQuality from the FINAL EM pass's solves (leading
+    # cluster axis) plus a whole-solution bundle at the returned
+    # parameters, in SageResult.quality.  Same static-gate contract as
+    # collect_telemetry: off builds the identical jaxpr.
+    collect_quality: bool = struct.field(pytree_node=False, default=False)
 
 
 class ClusterData(NamedTuple):
@@ -125,6 +131,11 @@ class SageResult(NamedTuple):
     # config.collect_telemetry, else None (empty pytree — jitted output
     # signature unchanged)
     telemetry: Optional[dict] = None
+    # {"em": SolveQuality stacked over clusters from the final EM pass,
+    # "final": whole-solution SolveQuality (chi^2 attribution of the
+    # full residual at the returned p + gain health)} when
+    # config.collect_quality, else None (same empty-pytree contract)
+    quality: Optional[dict] = None
 
 
 def build_cluster_data(
@@ -516,14 +527,23 @@ def sagefit(
         return jnp.where(c0 > 0.0, jnp.maximum((c0 - c1) / c0, 0.0), 0.0)
 
     collect = config.collect_telemetry
-
-    def _aux_of(res, nu_k):
-        aux = (_nerr_of(res), nu_k)
-        return aux + (res.trace,) if collect else aux
+    collect_q = config.collect_quality
 
     def em_iteration(p_all, nerr, nus_in, weighted, em_idx, key):
         """One EM pass over clusters via :func:`em_residual_scan`."""
         last_em = em_idx == config.max_emiter - 1
+        # quality side outputs only on the final pass: earlier iterates
+        # are discarded, so attributing them would just burn reductions
+        want_q = collect_q and last_em
+
+        def _aux_of(res, nu_k):
+            aux = (_nerr_of(res), nu_k)
+            if collect:
+                aux = aux + (res.trace,)
+            if want_q:
+                aux = aux + (res.quality,)
+            return aux
+
         use_robust = robust and last_em
         # OS acceleration on non-final EM passes (lmfit.c:906-934)
         use_os = (
@@ -555,7 +575,7 @@ def sagefit(
                     RTRConfig(itmax_rsd=iter_cap + 5,
                               itmax_rtr=iter_cap + 10),
                     itmax_dynamic=itermax,
-                    collect_trace=collect,
+                    collect_trace=collect, collect_quality=want_q,
                 )
                 return res.p, _aux_of(res, jnp.asarray(config.nulow, p_all.dtype))
             if mode == SM_RTR_OSRLM_RLBFGS:
@@ -570,7 +590,7 @@ def sagefit(
                     nu0=nu_prev, nulow=config.nulow, nuhigh=config.nuhigh,
                     em_iters=config.em_rounds_robust,
                     itmax_dynamic=itermax,
-                    collect_trace=collect,
+                    collect_trace=collect, collect_quality=want_q,
                 )
                 return res.p, _aux_of(res, nu_k.astype(p_all.dtype))
             if mode == SM_NSD_RLBFGS:
@@ -583,7 +603,7 @@ def sagefit(
                     nu0=nu_prev, nulow=config.nulow, nuhigh=config.nuhigh,
                     em_iters=config.em_rounds_robust,
                     itmax_dynamic=itermax,
-                    collect_trace=collect,
+                    collect_trace=collect, collect_quality=want_q,
                 )
                 return res.p, _aux_of(res, nu_k.astype(p_all.dtype))
             if use_robust:
@@ -592,18 +612,20 @@ def sagefit(
                     nu0=config.nulow, nulow=config.nulow, nuhigh=config.nuhigh,
                     em_iters=config.em_rounds_robust,
                     config=LMConfig(itmax=config.max_iter),
-                    collect_trace=collect,
+                    collect_trace=collect, collect_quality=want_q,
                 )
             elif use_os:
                 res = os_lm_solve(
                     xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
                     lmcfg, nsubsets=2, key=key_k, collect_trace=collect,
+                    collect_quality=want_q,
                 )
                 nu_k = jnp.asarray(config.nulow, p_all.dtype)
             else:
                 res = lm_solve(
                     xeff, coh_k, data.mask, data.ant_p, data.ant_q, cmap_k, p_k,
                     lmcfg, itmax_dynamic=itermax, collect_trace=collect,
+                    collect_quality=want_q,
                 )
                 nu_k = jnp.asarray(config.nulow, p_all.dtype)
             return res.p, _aux_of(res, nu_k)
@@ -613,19 +635,25 @@ def sagefit(
         )
         nerr_new, nus = aux[0], aux[1]
         tr = aux[2] if collect else None  # IterTrace, leading cluster axis
+        # SolveQuality with leading cluster axis on the final pass
+        qual = aux[-1] if want_q else None
         total = jnp.sum(nerr_new)
         nerr_norm = jnp.where(total > 0.0, nerr_new / total, nerr_new)
-        return p_new, nerr_norm, nus, key, tr
+        return p_new, nerr_norm, nus, key, tr, qual
 
     p = p0
     nerr = jnp.zeros((M,), p0.dtype)
     weighted = jnp.asarray(False)
     nus = jnp.full((M,), config.nulow, p0.dtype)
     em_traces = []
+    em_quality = None
     for em in range(config.max_emiter):
-        p, nerr, nus, key, tr = em_iteration(p, nerr, nus, weighted, em, key)
+        p, nerr, nus, key, tr, qual = em_iteration(
+            p, nerr, nus, weighted, em, key)
         if collect:
             em_traces.append(tr)
+        if qual is not None:
+            em_quality = qual
         if config.randomize:
             weighted = ~weighted
     mean_nu = jnp.clip(jnp.mean(nus), config.nulow, config.nuhigh)
@@ -674,9 +702,36 @@ def sagefit(
     telemetry = (
         {"em": tuple(em_traces), "lbfgs": lbfgs_trace} if collect else None
     )
+    quality = None
+    if collect_q:
+        # whole-solution bundle: chi^2 of the FULL residual (all cluster
+        # models subtracted) attributed per station/baseline, plus gain
+        # health over every (cluster, chunk) lane.  No hybrid-chunk
+        # structure exists for the joint residual, so chi2_chunk is the
+        # single total.
+        from sagecal_tpu.core.types import reals_of_flat
+        from sagecal_tpu.ops.quality import (
+            SolveQuality, chi2_scatter, gain_health, row_chi2,
+        )
+
+        e = reals_of_flat((data.vis - full1) * data.mask[..., None, :])
+        row = row_chi2(e)
+        chi2_st, chi2_bl, chi2_ch = chi2_scatter(
+            row, data.ant_p, data.ant_q, jnp.zeros_like(data.ant_p),
+            n8 // 8, 1,
+        )
+        nonfinite, amp, amp_sp, ph_sp, dep = gain_health(p)
+        final_q = SolveQuality(
+            chi2_station=chi2_st, chi2_baseline=chi2_bl,
+            chi2_chunk=chi2_ch, nonfinite_count=nonfinite,
+            station_amp=amp, station_amp_spread=amp_sp,
+            station_phase_spread=ph_sp, identity_departure=dep,
+            nu=mean_nu if robust else None,
+        )
+        quality = {"em": em_quality, "final": final_q}
     return SageResult(
         p=p, res_0=res_0, res_1=res_1, mean_nu=mean_nu,
-        diverged=res_1 > res_0, telemetry=telemetry,
+        diverged=res_1 > res_0, telemetry=telemetry, quality=quality,
     )
 
 

@@ -31,6 +31,7 @@ from sagecal_tpu.utils.platform import shard_map
 
 from sagecal_tpu.core.types import VisData
 from sagecal_tpu.obs.perf import instrumented_jit
+from sagecal_tpu.ops.quality import SolveQuality, chi2_scatter, gain_health
 from sagecal_tpu.solvers.lbfgs import lbfgs_fit
 from sagecal_tpu.solvers.sage import ClusterData, predict_full_model
 
@@ -99,6 +100,7 @@ def make_sharded_joint_fn(
     itmax: int = 30,
     lbfgs_m: int = 7,
     robust_nu: Optional[float] = None,
+    collect_quality: bool = False,
 ):
     """Build the jitted rows-sharded joint-LBFGS program.
 
@@ -106,7 +108,16 @@ def make_sharded_joint_fn(
     pytrees (only shapes/dtypes are read here) — the latter enables AOT
     ``.lower().compile()`` at scale without materializing the arrays
     (the graded-config memory checks, tests/test_graded_shapes.py).
-    Returns ``fn(data, cdata, p0) -> (p, cost, iterations)``.
+    Returns ``fn(data, cdata, p0) -> (p, cost, iterations)``, or
+    ``(p, cost, iterations, quality)`` with ``collect_quality`` — a
+    static build parameter, so the two variants are distinct programs
+    and the disabled path's signature is untouched.  ``quality`` is an
+    :class:`sagecal_tpu.ops.quality.SolveQuality` whose chi^2
+    attribution uses the joint objective density (``e^2``, or
+    ``log1p(e^2/nu)`` on the robust path) so the station/baseline sums
+    and the total reproduce ``cost`` exactly; the per-shard scatters are
+    psum'd across the mesh, the same one-collective-per-reduction
+    pattern as the solve itself.
     """
     ndev = mesh.devices.size
     rows = data.vis.shape[-1]
@@ -116,26 +127,75 @@ def make_sharded_joint_fn(
     data_specs, cdata_specs = _build_specs(data, cdata, rows, axis_name)
 
     def local_fit(data_l, cdata_l, p0_l):
-        def cost_fn(pflat):
+        def local_cost(pflat):
             pa = pflat.reshape(shp)
             model = predict_full_model(pa, cdata_l, data_l)
             diff = (data_l.vis - model) * data_l.mask[..., None, :]
             e2 = jnp.real(diff) ** 2 + jnp.imag(diff) ** 2
             if robust_nu is not None:
-                local = jnp.sum(jnp.log1p(e2 / robust_nu))
-            else:
-                local = jnp.sum(e2)
-            return jax.lax.psum(local, axis_name)
+                return jnp.sum(jnp.log1p(e2 / robust_nu))
+            return jnp.sum(e2)
+
+        def cost_fn(pflat):
+            return jax.lax.psum(local_cost(pflat), axis_name)
+
+        # The gradient must be psum'd EXPLICITLY: differentiating through
+        # a psum'd cost transposes the psum into a device-local
+        # cotangent, so value_and_grad(cost_fn) would hand each device
+        # only its own shard's gradient — per-device LBFGS trajectories
+        # then diverge, and the data-dependent Armijo while_loop executes
+        # different psum counts per device (an XLA collective-rendezvous
+        # deadlock).  One psum of the (value, grad) tuple per evaluation
+        # keeps every device on the identical global iterate.
+        def vg_fn(pflat):
+            return jax.lax.psum(
+                jax.value_and_grad(local_cost)(pflat), axis_name
+            )
 
         fit = lbfgs_fit(cost_fn, None, p0_l.reshape(-1), itmax=itmax,
-                        M=lbfgs_m)
-        return fit.p.reshape(shp), fit.cost, fit.iterations
+                        M=lbfgs_m, vg_fn=vg_fn)
+        pf = fit.p.reshape(shp)
+        if not collect_quality:
+            return pf, fit.cost, fit.iterations
+        # objective density of the final iterate, scattered per station/
+        # baseline on each shard's local rows, then psum'd — sums equal
+        # fit.cost exactly (it is the same reduction, reassociated)
+        model = predict_full_model(pf, cdata_l, data_l)
+        diff = (data_l.vis - model) * data_l.mask[..., None, :]
+        e2 = jnp.real(diff) ** 2 + jnp.imag(diff) ** 2
+        dens = jnp.log1p(e2 / robust_nu) if robust_nu is not None else e2
+        row = jnp.sum(dens, axis=(-3, -2))  # (rows_local,)
+        n_st = shp[-1] // 8
+        chi2_st, chi2_bl, chi2_tot = chi2_scatter(
+            row, data_l.ant_p, data_l.ant_q,
+            jnp.zeros_like(data_l.ant_p), n_st, 1,
+        )
+        chi2_st, chi2_bl, chi2_tot = jax.lax.psum(
+            (chi2_st, chi2_bl, chi2_tot), axis_name
+        )
+        nonfinite, amp, amp_sp, ph_sp, dep = gain_health(pf)
+        quality = SolveQuality(
+            chi2_station=chi2_st, chi2_baseline=chi2_bl,
+            chi2_chunk=chi2_tot, nonfinite_count=nonfinite,
+            station_amp=amp, station_amp_spread=amp_sp,
+            station_phase_spread=ph_sp, identity_departure=dep,
+        )
+        return pf, fit.cost, fit.iterations, quality
 
+    out_specs = (P(), P(), P())
+    if collect_quality:
+        # replicated specs for exactly the fields local_fit fills; the
+        # rest stay None (empty pytree) and need no spec
+        out_specs = out_specs + (SolveQuality(
+            chi2_station=P(), chi2_baseline=P(), chi2_chunk=P(),
+            nonfinite_count=P(), station_amp=P(), station_amp_spread=P(),
+            station_phase_spread=P(), identity_departure=P(),
+        ),)
     fn = shard_map(
         local_fit,
         mesh=mesh,
         in_specs=(data_specs, cdata_specs, P()),
-        out_specs=(P(), P(), P()),
+        out_specs=out_specs,
     )
     return instrumented_jit(fn, name="sharded_joint_fit")
 
@@ -149,15 +209,18 @@ def sharded_joint_fit(
     itmax: int = 30,
     lbfgs_m: int = 7,
     robust_nu: Optional[float] = None,
+    collect_quality: bool = False,
 ):
     """Joint LBFGS over all clusters with rows sharded over ``mesh``.
 
     ``p0``: (M, nchunk, 8N).  Returns (p, cost, iterations) with ``p``
-    replicated.  Rows must divide evenly by the mesh size — use
-    :func:`pad_rows_to` first.
+    replicated — plus a psum'd :class:`SolveQuality` as a fourth element
+    when ``collect_quality`` (see :func:`make_sharded_joint_fn`).  Rows
+    must divide evenly by the mesh size — use :func:`pad_rows_to` first.
     """
     fn = make_sharded_joint_fn(
         data, cdata, p0.shape, mesh, axis_name=axis_name, itmax=itmax,
         lbfgs_m=lbfgs_m, robust_nu=robust_nu,
+        collect_quality=collect_quality,
     )
     return fn(data, cdata, p0)

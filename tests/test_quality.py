@@ -1,0 +1,531 @@
+"""Calibration-quality observability (ops/quality.py + obs/quality.py).
+
+Pins the chi^2 attribution invariants against the solvers' own reported
+costs (Gaussian, robust, and rows-sharded paths), the zero-recompile
+contract of the statically-gated quality side outputs, the host-side
+watchdog verdicts, the ``diag quality`` CLI exit codes, and the
+``abort_on_divergence`` escalation path end-to-end through the
+fullbatch app.
+"""
+
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sagecal_tpu.core.types import identity_jones, jones_to_params
+from sagecal_tpu.io.simulate import corrupt_and_observe, make_visdata, random_jones
+from sagecal_tpu.obs.events import EventLog, read_events
+from sagecal_tpu.obs.perf import perf_stats, reset_perf_stats
+from sagecal_tpu.obs.quality import (
+    DivergenceAbort,
+    abort_if_diverged,
+    analyze_events,
+    assess_consensus,
+    assess_quality,
+    check_and_emit,
+    quality_summary,
+    quality_to_host,
+    write_baseline_heatmap,
+    write_station_heatmap,
+)
+from sagecal_tpu.obs.registry import telemetry
+from sagecal_tpu.ops.quality import SolveQuality, gain_health
+from sagecal_tpu.ops.rime import point_source_batch, predict_coherencies
+from sagecal_tpu.solvers.lm import LMConfig, lm_solve, lm_solve_jit
+from sagecal_tpu.solvers.robust import robust_lm_solve
+
+
+pytestmark = pytest.mark.quality
+
+
+def _scene(nst=7, tilesz=2, noise=0.05, seed=3):
+    """Single-cluster scene (test_solvers idiom) with enough noise that
+    the converged cost is a healthy positive number (the chi^2 == cost
+    comparisons are relative)."""
+    d = make_visdata(nstations=nst, tilesz=tilesz, nchan=1, seed=seed)
+    rng = np.random.default_rng(seed)
+    S = 3
+    src = point_source_batch(
+        jnp.asarray(0.01 * rng.standard_normal(S), jnp.float32),
+        jnp.asarray(0.01 * rng.standard_normal(S), jnp.float32),
+        jnp.asarray(rng.uniform(1.0, 3.0, S), jnp.float32),
+    )
+    J = random_jones(1, nst, seed=seed, amp=0.2)
+    obs = corrupt_and_observe(d, [src], jones=J, noise_sigma=noise, seed=seed + 1)
+    coh = predict_coherencies(d.u, d.v, d.w, d.freqs, src)
+    return d, obs, coh
+
+
+class TestChi2Attribution:
+    """Satellite invariant: the attribution is the solver's own final
+    objective, re-scattered — per chunk it IS the cost; the baseline
+    matrix sums to it; the station vector double-counts it (each row
+    charges both of its stations)."""
+
+    def _check_invariants(self, q, cost, rtol):
+        chunk = np.asarray(q.chi2_chunk)
+        cost = np.asarray(cost)
+        np.testing.assert_allclose(chunk, cost, rtol=rtol)
+        np.testing.assert_allclose(
+            float(np.sum(np.asarray(q.chi2_baseline))),
+            float(np.sum(cost)), rtol=rtol)
+        np.testing.assert_allclose(
+            float(np.sum(np.asarray(q.chi2_station))),
+            2.0 * float(np.sum(cost)), rtol=rtol)
+
+    def test_gaussian_lm_matches_cost(self):
+        d, obs, coh = _scene()
+        p0 = jones_to_params(identity_jones(d.nstations))[None]
+        chunk_map = jnp.zeros((d.rows,), jnp.int32)
+        res = lm_solve(
+            obs.vis, coh, obs.mask, obs.ant_p, obs.ant_q, chunk_map, p0,
+            LMConfig(itmax=20), collect_quality=True,
+        )
+        assert res.quality is not None
+        self._check_invariants(res.quality, res.cost, rtol=1e-4)
+        # gain health rode along: finite solve, per-station summaries
+        assert float(res.quality.nonfinite_count) == 0.0
+        assert res.quality.station_amp.shape == (d.nstations,)
+        # Gaussian path has no weight statistics
+        assert res.quality.nu is None and res.quality.weight_hist is None
+
+    def test_gaussian_lm_hybrid_chunks_per_chunk(self):
+        # two hybrid chunks: the attribution must match the per-chunk
+        # cost vector elementwise, not just in total
+        d, obs, coh = _scene(nst=6, tilesz=2, seed=11)
+        nst = d.nstations
+        p0 = jnp.broadcast_to(
+            jones_to_params(identity_jones(nst))[None], (2, 8 * nst)
+        )
+        chunk_map = d.time_idx  # timeslot == chunk
+        res = lm_solve(
+            obs.vis, coh, obs.mask, obs.ant_p, obs.ant_q, chunk_map, p0,
+            LMConfig(itmax=15), collect_quality=True,
+        )
+        assert res.quality.chi2_chunk.shape == (2,)
+        self._check_invariants(res.quality, res.cost, rtol=1e-4)
+
+    def test_robust_lm_matches_weighted_cost(self):
+        d, obs, coh = _scene(seed=5)
+        p0 = jones_to_params(identity_jones(d.nstations))[None]
+        chunk_map = jnp.zeros((d.rows,), jnp.int32)
+        res, nu = robust_lm_solve(
+            obs.vis, coh, obs.mask, obs.ant_p, obs.ant_q, chunk_map, p0,
+            em_iters=2, config=LMConfig(itmax=12), collect_quality=True,
+        )
+        q = res.quality
+        # the final weighted solve's objective, re-scattered
+        self._check_invariants(q, res.cost, rtol=1e-4)
+        # robust enrichment: converged nu + weight statistics
+        np.testing.assert_allclose(float(q.nu), float(nu), rtol=1e-6)
+        assert 2.0 <= float(q.nu) <= 30.0
+        # histogram counts every unflagged residual element (8 reals per
+        # row, mask broadcast over them)
+        n_valid = 8.0 * float(np.sum(np.asarray(obs.mask)))
+        np.testing.assert_allclose(
+            float(np.sum(np.asarray(q.weight_hist))), n_valid, rtol=1e-6)
+        assert 0.0 <= float(q.downweighted_frac) <= 1.0
+        assert float(q.flagged_frac) == pytest.approx(
+            1.0 - n_valid / (8.0 * np.asarray(obs.mask).size), abs=1e-6)
+
+    @pytest.mark.parametrize("robust_nu", [None, 5.0])
+    def test_sharded_joint_fit_matches_cost(self, devices8, robust_nu):
+        import jax
+        from jax.sharding import Mesh
+
+        from sagecal_tpu.solvers.sage import build_cluster_data
+        from sagecal_tpu.solvers.sharded import pad_rows_to, sharded_joint_fit
+
+        m, nst, f0 = 2, 7, 150e6
+        data = make_visdata(nstations=nst, tilesz=4, nchan=1, freq0=f0,
+                            dtype=np.float64, seed=6)
+        rng = np.random.default_rng(6)
+        clusters = [
+            point_source_batch([rng.uniform(-0.03, 0.03)],
+                               [rng.uniform(-0.03, 0.03)],
+                               [rng.uniform(1.0, 3.0)], f0=f0,
+                               dtype=jnp.float64)
+            for _ in range(m)
+        ]
+        jt = random_jones(m, nst, seed=8, amp=0.1, dtype=np.complex128)
+        data = corrupt_and_observe(data, clusters, jones=jt, noise_sigma=1e-3)
+        cdata = build_cluster_data(data, clusters, [1] * m, fdelta=0.0)
+        p0 = jones_to_params(
+            jnp.broadcast_to(identity_jones(nst, jnp.complex128),
+                             (m, 1, nst, 2, 2))
+        )
+        mesh = Mesh(np.array(devices8), ("rows",))
+        data_p, cdata_p = pad_rows_to(data, cdata, 8)
+        p, cost, it, q = sharded_joint_fit(
+            data_p, cdata_p, p0, mesh, itmax=12, robust_nu=robust_nu,
+            collect_quality=True,
+        )
+        # the psum'd scatters are the joint objective density reassociated
+        cost = float(cost)
+        np.testing.assert_allclose(
+            float(np.sum(np.asarray(q.chi2_chunk))), cost, rtol=1e-9)
+        np.testing.assert_allclose(
+            float(np.sum(np.asarray(q.chi2_baseline))), cost, rtol=1e-9)
+        np.testing.assert_allclose(
+            float(np.sum(np.asarray(q.chi2_station))), 2.0 * cost, rtol=1e-9)
+        assert float(q.nonfinite_count) == 0.0
+
+
+class TestZeroRecompile:
+    """Acceptance: quality side outputs are statically gated — each
+    variant of a solver compiles exactly once, toggling never invalidates
+    the other variant's cache, and the returned solution is identical."""
+
+    def test_lm_quality_toggle_compiles_each_variant_once(self):
+        # unique shapes (nst=5) so this test owns its jit-cache entries
+        d, obs, coh = _scene(nst=5, tilesz=2, seed=21)
+        p0 = jones_to_params(identity_jones(d.nstations))[None]
+        chunk_map = jnp.zeros((d.rows,), jnp.int32)
+        args = (obs.vis, coh, obs.mask, obs.ant_p, obs.ant_q, chunk_map,
+                p0, LMConfig(itmax=6))
+        with telemetry(True):
+            reset_perf_stats()
+            r_off = lm_solve_jit(*args, collect_quality=False)
+            lm_solve_jit(*args, collect_quality=False)
+            assert perf_stats()["lm_solve"]["compiles"] == 1
+            r_on = lm_solve_jit(*args, collect_quality=True)
+            lm_solve_jit(*args, collect_quality=True)
+            # one extra compile for the statically-distinct variant...
+            assert perf_stats()["lm_solve"]["compiles"] == 2
+            # ...and flipping back costs nothing
+            lm_solve_jit(*args, collect_quality=False)
+            assert perf_stats()["lm_solve"]["compiles"] == 2
+        # output-signature equivalence: quality rides along as extra
+        # outputs; the solve itself is bit-identical, and the disabled
+        # path's slot stays an empty pytree
+        assert r_off.quality is None
+        assert r_on.quality is not None
+        np.testing.assert_array_equal(np.asarray(r_off.p), np.asarray(r_on.p))
+        np.testing.assert_array_equal(np.asarray(r_off.cost),
+                                      np.asarray(r_on.cost))
+
+
+def _qdict(nst=7, **over):
+    qd = {
+        "chi2_station": np.full(nst, 2.0),
+        "chi2_baseline": np.full((nst, nst), 0.1),
+        "chi2_chunk": np.array([7.0]),
+        "nonfinite_count": np.array(0.0),
+    }
+    qd.update(over)
+    return qd
+
+
+class TestAssessQuality:
+    def test_clean_solve_is_ok(self):
+        verdict, reasons = assess_quality(_qdict())
+        assert verdict == "ok" and reasons == []
+
+    def test_nan_gains_diverge(self):
+        verdict, reasons = assess_quality(
+            _qdict(nonfinite_count=np.array(8.0)))
+        assert verdict == "diverged"
+        assert any(r.startswith("nonfinite_gains:8") for r in reasons)
+
+    def test_nan_chi2_diverges(self):
+        st = np.full(7, 2.0)
+        st[3] = np.nan
+        verdict, reasons = assess_quality(_qdict(chi2_station=st))
+        assert verdict == "diverged" and "nonfinite_chi2" in reasons
+
+    def test_outlier_station_degrades(self):
+        st = np.full(7, 2.0)
+        st[4] = 2.0 * 1000.0
+        verdict, reasons = assess_quality(_qdict(chi2_station=st))
+        assert verdict == "degraded"
+        assert any(r == "station_chi2_outlier:4" for r in reasons)
+
+    def test_downweighted_data_degrades(self):
+        verdict, reasons = assess_quality(
+            _qdict(downweighted_frac=np.array(0.9)))
+        assert verdict == "degraded"
+        assert any(r.startswith("downweighted_frac:") for r in reasons)
+
+    def test_sage_bundle_assessed_on_final(self):
+        bundle = {"em": _qdict(), "final": _qdict(nonfinite_count=np.array(1.0))}
+        verdict, _ = assess_quality(bundle)
+        assert verdict == "diverged"
+
+    def test_quality_to_host_on_sage_bundle(self):
+        q = SolveQuality(chi2_chunk=jnp.asarray([3.0]),
+                         nonfinite_count=jnp.asarray(0.0))
+        out = quality_to_host({"em": q, "final": q})
+        assert set(out) == {"em", "final"}
+        assert isinstance(out["final"]["chi2_chunk"], np.ndarray)
+        # None fields dropped
+        assert "chi2_station" not in out["final"]
+        # stacked per-cluster station chi^2 reduces to totals
+        stacked = _qdict(chi2_station=np.full((3, 7), 2.0))
+        s = quality_summary(stacked)
+        np.testing.assert_allclose(s["chi2_station"], np.full(7, 6.0))
+
+    def test_injected_nan_station_trips_gain_health(self):
+        # the acceptance scenario: NaN injected into one station's gains
+        nst = 6
+        p = np.asarray(jones_to_params(identity_jones(nst))[None], float)
+        p[0, 8 * 2:8 * 3] = np.nan  # station 2, all 8 params
+        nonfinite, amp, amp_sp, ph_sp, dep = gain_health(jnp.asarray(p))
+        assert float(nonfinite) == 8.0
+        # sanitized before the summaries: no NaN poisoning
+        assert np.all(np.isfinite(np.asarray(amp)))
+        verdict, _ = assess_quality(
+            {"nonfinite_count": np.asarray(nonfinite)})
+        assert verdict == "diverged"
+
+
+class TestAssessConsensus:
+    def test_shrinking_primal_is_ok(self):
+        pr = np.array([[1.0, 2.0], [0.5, 1.0], [0.2, 0.4]])
+        du = np.ones_like(pr)
+        verdict, reasons, health = assess_consensus(pr, du)
+        assert verdict == "ok" and reasons == []
+        assert health["ratio"].shape == (2,)
+        assert not np.any(health["diverged"])
+
+    def test_runaway_primal_diverges(self):
+        pr = np.array([[0.1, 0.1], [0.5, 0.1], [1.0, 0.1]])
+        du = np.ones_like(pr)
+        verdict, reasons, health = assess_consensus(pr, du)
+        assert verdict == "diverged"
+        assert reasons == ["consensus_diverged_bands:0"]
+        assert bool(health["diverged"][0]) and not bool(health["diverged"][1])
+
+
+class TestHeatmaps:
+    def _assert_valid_ppm(self, path):
+        with open(path, "rb") as f:
+            head = f.read(2)
+            assert head == b"P6"
+
+    def test_station_heatmap_from_vector_and_matrix(self, tmp_path):
+        p1 = str(tmp_path / "st1.ppm")
+        write_station_heatmap(np.array([1.0, 10.0, 100.0]), p1)
+        self._assert_valid_ppm(p1)
+        p2 = str(tmp_path / "st2.ppm")
+        write_station_heatmap(np.random.default_rng(0).random((4, 7)), p2)
+        self._assert_valid_ppm(p2)
+
+    def test_baseline_heatmap_handles_nonfinite(self, tmp_path):
+        a = np.random.default_rng(1).random((7, 7))
+        a[2, 5] = np.nan  # renders hot rather than crashing
+        p = str(tmp_path / "bl.ppm")
+        write_baseline_heatmap(a, p)
+        self._assert_valid_ppm(p)
+
+
+class TestWatchdogEvents:
+    def test_check_and_emit_writes_escalation(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        q = SolveQuality(chi2_chunk=jnp.asarray([5.0]),
+                         nonfinite_count=jnp.asarray(3.0))
+        with EventLog(path) as elog:
+            verdict, reasons = check_and_emit(elog, q, tile=0, app="test")
+        assert verdict == "diverged"
+        types = [e["type"] for e in read_events(path)]
+        assert "solve_quality" in types and "solver_diverged" in types
+        sq = next(e for e in read_events(path) if e["type"] == "solve_quality")
+        assert sq["verdict"] == "diverged" and sq["tile"] == 0
+
+    def test_check_and_emit_without_log_still_assesses(self):
+        q = SolveQuality(nonfinite_count=jnp.asarray(1.0))
+        verdict, _ = check_and_emit(None, q)
+        assert verdict == "diverged"
+
+    def test_abort_if_diverged(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        elog = EventLog(path)
+        with pytest.raises(DivergenceAbort):
+            abort_if_diverged(elog, "diverged", ["nonfinite_gains:8"],
+                              tile=2)
+        evs = read_events(path)
+        assert evs[-1]["type"] == "run_aborted"
+        assert evs[-1]["reason"] == "solver_diverged"
+        assert evs[-1]["details"] == ["nonfinite_gains:8"]
+        # ok / degraded verdicts are a no-op
+        abort_if_diverged(None, "ok", [])
+        abort_if_diverged(None, "degraded", ["downweighted_frac:0.6"])
+
+
+class TestAnalyzeEventsAndDiagCLI:
+    def _write_log(self, path, diverged=False):
+        with EventLog(str(path)) as elog:
+            st = np.full(7, 2.0)
+            elog.emit("solve_quality", verdict="ok", reasons=[],
+                      chi2_station=st, chi2_baseline=np.full((7, 7), 0.1),
+                      chi2_chunk=[7.0], chi2_total=7.0,
+                      nonfinite_count=0.0, tile=0)
+            elog.emit("admm_round", tile=0,
+                      primal_res_band=[[1.0], [0.5], [0.2]],
+                      dual_res_band=[[1.0], [1.0], [1.0]])
+            if diverged:
+                elog.emit("solver_diverged",
+                          reasons=["nonfinite_gains:8"], tile=1)
+
+    def test_analyze_events_clean(self, tmp_path):
+        p = tmp_path / "ev.jsonl"
+        self._write_log(p)
+        report = analyze_events(read_events(str(p)))
+        assert not report["diverged"] and not report["degraded"]
+        assert report["n_solve_quality_events"] == 1
+        assert report["station_matrix"].shape == (1, 7)
+        assert report["baseline_total"].shape == (7, 7)
+        assert report["consensus"][0]["verdict"] == "ok"
+
+    def test_analyze_events_diverged(self, tmp_path):
+        p = tmp_path / "ev.jsonl"
+        self._write_log(p, diverged=True)
+        report = analyze_events(read_events(str(p)))
+        assert report["diverged"]
+        assert any("nonfinite_gains" in r for r in report["reasons"])
+
+    def test_diag_quality_cli_exit_codes(self, tmp_path):
+        from sagecal_tpu.obs.diag import main as diag_main
+
+        clean = tmp_path / "clean.jsonl"
+        self._write_log(clean)
+        out = tmp_path / "rep"
+        assert diag_main(["quality", str(clean), "--out-dir", str(out)]) == 0
+        report = json.loads((out / "quality_report.json").read_text())
+        assert report["diverged"] is False
+        assert (out / "station_chi2.ppm").exists()
+        assert (out / "baseline_chi2.ppm").exists()
+
+        bad = tmp_path / "bad.jsonl"
+        self._write_log(bad, diverged=True)
+        assert diag_main(["quality", str(bad), "--out-dir", str(out)]) == 1
+
+    def test_diag_quality_fail_degraded(self, tmp_path):
+        from sagecal_tpu.obs.diag import main as diag_main
+
+        p = tmp_path / "deg.jsonl"
+        st = np.full(7, 2.0)
+        st[3] = 1e4  # outlier station -> degraded
+        with EventLog(str(p)) as elog:
+            elog.emit("solve_quality", chi2_station=st, nonfinite_count=0.0)
+        assert diag_main(["quality", str(p), "--out-dir",
+                          str(tmp_path)]) == 0
+        assert diag_main(["quality", str(p), "--out-dir", str(tmp_path),
+                          "--fail-degraded"]) == 1
+
+
+# ----------------------------------------------------- app-level escalation
+
+SKY = """P1 0 0 0.0 51 0 0.0 2.0 0 0 0 0 0 0 0 0 0 0 150e6
+P2 0 2 0.0 50 30 0.0 1.0 0 0 0 0 0 0 0 0 0 0 150e6
+"""
+CLUSTER = "1 1 P1\n2 1 P2\n"
+
+
+def _make_dataset(path):
+    """Tiny dataset matching SKY (test_apps idiom)."""
+    import tempfile
+
+    from sagecal_tpu.io.dataset import simulate_dataset
+    from sagecal_tpu.io.skymodel import load_sky
+
+    with tempfile.TemporaryDirectory() as td:
+        skyf = os.path.join(td, "s.txt")
+        open(skyf, "w").write(SKY)
+        open(skyf + ".cluster", "w").write(CLUSTER)
+        clusters, _, _ = load_sky(skyf, skyf + ".cluster",
+                                  0.0, math.radians(51.0), dtype=np.float64)
+    jones = random_jones(2, 7, seed=3, amp=0.3, dtype=np.complex128)
+    simulate_dataset(
+        str(path), nstations=7, ntime=4, nchan=2, clusters=clusters,
+        jones=jones, noise_sigma=1e-4, seed=0, dec0=math.radians(51.0),
+    )
+    import h5py
+
+    with h5py.File(str(path), "r+") as f:
+        f.attrs["ra0"] = 0.0
+        f.attrs["dec0"] = math.radians(51.0)
+
+
+class TestAbortOnDivergence:
+    def test_cli_flag_parses_into_config(self):
+        from sagecal_tpu.apps.cli import build_parser, config_from_args
+
+        args = build_parser().parse_args(
+            ["-d", "x.h5", "-s", "sky.txt", "--abort-on-divergence"])
+        assert config_from_args(args).abort_on_divergence is True
+        args = build_parser().parse_args(["-d", "x.h5", "-s", "sky.txt"])
+        assert config_from_args(args).abort_on_divergence is False
+
+    def test_fullbatch_abort_emits_structured_events(self, tmp_path,
+                                                     monkeypatch):
+        """End-to-end escalation: an absurd res_ratio makes the first
+        tile's solve count as diverged; with abort_on_divergence the app
+        must raise DivergenceAbort after logging solver_diverged +
+        run_aborted, and ``diag quality`` on that log exits nonzero."""
+        from sagecal_tpu.apps.config import RunConfig
+        from sagecal_tpu.apps.fullbatch import run_fullbatch
+        from sagecal_tpu.obs.diag import main as diag_main
+
+        sky = tmp_path / "t.sky.txt"
+        sky.write_text(SKY)
+        (tmp_path / "t.sky.txt.cluster").write_text(CLUSTER)
+        dsp = tmp_path / "d.h5"
+        _make_dataset(dsp)
+
+        evpath = tmp_path / "events.jsonl"
+        monkeypatch.setenv("SAGECAL_EVENT_LOG", str(evpath))
+        cfg = RunConfig(
+            dataset=str(dsp), sky_model=str(sky),
+            cluster_file=str(sky) + ".cluster",
+            out_solutions=str(tmp_path / "sol.txt"),
+            tilesz=4, max_emiter=1, max_iter=3, max_lbfgs=5,
+            res_ratio=1e-9, abort_on_divergence=True,
+        )
+        with telemetry(True):
+            with pytest.raises(DivergenceAbort):
+                run_fullbatch(cfg, log=lambda *a: None)
+
+        evs = read_events(str(evpath))
+        types = [e["type"] for e in evs]
+        assert "solve_quality" in types     # quality collected + assessed
+        assert "solver_diverged" in types   # watchdog fired
+        assert "run_aborted" in types       # structured abort
+        aborted = next(e for e in evs if e["type"] == "run_aborted")
+        assert aborted["reason"] == "solver_diverged"
+        assert any("residual_ratio" in d for d in aborted["details"])
+        # the gate the kernel-check script runs: nonzero on this log
+        assert diag_main(["quality", str(evpath), "--out-dir",
+                          str(tmp_path)]) == 1
+
+    def test_fullbatch_report_only_by_default(self, tmp_path, monkeypatch):
+        """Same divergence without the flag: the run completes (guard
+        resets p) and the events record the divergence for post-hoc
+        diag, but nothing raises."""
+        from sagecal_tpu.apps.config import RunConfig
+        from sagecal_tpu.apps.fullbatch import run_fullbatch
+
+        sky = tmp_path / "t.sky.txt"
+        sky.write_text(SKY)
+        (tmp_path / "t.sky.txt.cluster").write_text(CLUSTER)
+        dsp = tmp_path / "d.h5"
+        _make_dataset(dsp)
+        evpath = tmp_path / "events.jsonl"
+        monkeypatch.setenv("SAGECAL_EVENT_LOG", str(evpath))
+        cfg = RunConfig(
+            dataset=str(dsp), sky_model=str(sky),
+            cluster_file=str(sky) + ".cluster",
+            out_solutions=str(tmp_path / "sol.txt"),
+            tilesz=4, max_emiter=1, max_iter=3, max_lbfgs=5,
+            res_ratio=1e-9,
+        )
+        with telemetry(True):
+            results = run_fullbatch(cfg, log=lambda *a: None)
+        assert len(results) == 1
+        types = [e["type"] for e in read_events(str(evpath))]
+        assert "solver_diverged" in types
+        assert "run_aborted" not in types
+        assert "run_done" in types

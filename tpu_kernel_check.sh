@@ -52,11 +52,18 @@ if probe; then
   timeout 60 python -m sagecal_tpu.obs.diag gate "$MANIFEST_DIR/bench_new.json" \
     --baseline /root/repo/BENCH_BASELINE.json \
     || { echo "PERF GATE FAILED vs BENCH_BASELINE.json"; exit 1; }
+  # calibration-quality gate: any solver_diverged / consensus runaway
+  # recorded in the run's events is a hard stop (heatmaps + JSON report
+  # land next to the manifests)
+  timeout 60 python -m sagecal_tpu.obs.diag quality \
+    "$MANIFEST_DIR/bench.jsonl" --out-dir "$MANIFEST_DIR" \
+    || { echo "QUALITY GATE FAILED (diverged run)"; exit 1; }
 fi
 echo "=== bf16-coherency fused bench"
 if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
-echo "=== telemetry-enabled test pass (CPU, marker-driven)"
+echo "=== telemetry+quality test pass (CPU, marker-driven)"
 JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 900 \
-  python -m pytest tests/ -q -m telemetry -p no:cacheprovider | tail -3
+  python -m pytest tests/ -q -m "telemetry or quality" \
+  -p no:cacheprovider | tail -3
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then echo "telemetry test pass FAILED rc=$rc"; exit 1; fi
