@@ -1,0 +1,46 @@
+"""jaxlint: AST-based JAX-discipline static analysis for sagecal-tpu.
+
+The correctness-critical invariants of the TPU port — no recompile
+hazards in jitted hot paths, fixed shapes, the float32/complex64
+precision policy, no hidden host<->device syncs, collectives confined
+to the parallel layer — were until now enforced only by hand-pinned
+tests (the zero-recompile pins in tests/test_quality.py and
+tests/test_perf_obs.py).  This package turns them into a mechanical,
+repo-wide gate:
+
+- :mod:`sagecal_tpu.analysis.callgraph` parses every module with stdlib
+  ``ast``, resolves imports, and computes the set of *jit-reachable*
+  functions by walking references outward from every ``jax.jit`` /
+  ``instrumented_jit`` wrap site (decorators, ``x_jit = jit(f)``
+  assignments, ``partial(jax.jit, ...)``, and one-level pass-through
+  wrappers like ``shard_map(f, ...)``).
+- :mod:`sagecal_tpu.analysis.rules` hosts one module per rule:
+  JL001 traced-value Python control flow, JL002 host-sync calls,
+  JL003 recompile hazards (undeclared static args), JL004 64-bit dtype
+  policy violations, JL005 data-dependent shapes in jit, JL006
+  collectives outside the parallel layer, and the report-only JL900
+  dead-import sweep.
+- :mod:`sagecal_tpu.analysis.engine` runs the rules, applies per-line
+  ``# jaxlint: disable=RULE`` suppression pragmas, and formats
+  text/JSON reports.
+- :mod:`sagecal_tpu.analysis.baseline` grandfathers pre-existing
+  findings through a committed JSON baseline so the gate only fails on
+  NEW findings.
+
+Run it as ``python -m sagecal_tpu.analysis sagecal_tpu/`` or via the
+CLI: ``sagecal-tpu diag lint sagecal_tpu/``.  Zero dependencies beyond
+the stdlib — importing this package never imports jax or numpy, so the
+gate runs on any host, backend or no backend.
+
+The static rules pair with a *runtime* contract layer
+(:mod:`sagecal_tpu.obs.contracts`): ``SAGECAL_CHECKIFY=1`` wraps the
+solver jit entries in ``jax.experimental.checkify`` NaN/div/index
+checks and surfaces failures as structured ``contract_violation``
+events.
+"""
+
+from sagecal_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    analyze_paths,
+)
+from sagecal_tpu.analysis.cli import main  # noqa: F401

@@ -1,0 +1,8 @@
+"""``python -m sagecal_tpu.analysis [paths...]`` — run the lint gate."""
+
+import sys
+
+from sagecal_tpu.analysis.cli import run
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

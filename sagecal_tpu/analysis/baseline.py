@@ -1,0 +1,80 @@
+"""Baseline file: grandfather pre-existing findings.
+
+The baseline is a committed JSON file mapping finding keys (rule, path,
+symbol, message) to an occurrence count.  The gate only fails on *new*
+findings — keys absent from the baseline, or present more often than the
+baseline allows.  Counts (rather than a set) make two identical findings
+in one file distinguishable from one.
+
+The repo ships an **empty** baseline (every finding is fixed or carries
+a reasoned pragma); the mechanism exists so future adopters of new rules
+can land the rule and burn down findings incrementally.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Counter, Iterable, List, Tuple
+
+from sagecal_tpu.analysis.engine import Finding
+
+_SEP = "\x1f"
+
+
+def _encode(key: Tuple[str, str, str, str]) -> str:
+    return _SEP.join(key)
+
+
+def _decode(s: str) -> Tuple[str, str, str, str]:
+    parts = s.split(_SEP)
+    while len(parts) < 4:
+        parts.append("")
+    return tuple(parts[:4])
+
+
+def load_baseline(path: str) -> Counter:
+    """Counter of finding keys; an absent file is an empty baseline."""
+    if not path or not os.path.isfile(path):
+        return collections.Counter()
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out: Counter = collections.Counter()
+    for rec in data.get("findings", []):
+        key = (rec["rule"], rec["path"], rec.get("symbol", ""),
+               rec["message"])
+        out[key] += int(rec.get("count", 1))
+    return out
+
+
+def save_baseline(path: str, findings: Iterable[Finding]) -> None:
+    counts: Counter = collections.Counter(
+        f.key() for f in findings if not f.report_only)
+    recs = [
+        {"rule": k[0], "path": k[1], "symbol": k[2], "message": k[3],
+         "count": n}
+        for k, n in sorted(counts.items())
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": recs}, f, indent=2,
+                  sort_keys=True)
+        f.write("\n")
+
+
+def partition(findings: Iterable[Finding], baseline: Counter):
+    """Split gate-relevant findings into (new, grandfathered) lists.
+
+    Report-only findings are never gated and appear in neither list."""
+    remaining = collections.Counter(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        if f.report_only:
+            continue
+        if remaining[f.key()] > 0:
+            remaining[f.key()] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old

@@ -1,0 +1,484 @@
+"""Module parsing + the jit-reachability call graph.
+
+Everything downstream (the JL rules) keys off two questions this module
+answers mechanically, per the repo's layering:
+
+1. *What does this dotted name mean here?* — per-module import tables
+   map local aliases to fully qualified names (``jnp`` -> ``jax.numpy``,
+   ``instrumented_jit`` -> ``sagecal_tpu.obs.perf.instrumented_jit``),
+   so rules match on canonical names, never on spelling.
+2. *Can this statement execute inside a jit trace?* — jit-roots are
+   collected from decorator and call-site wrap forms, then closed over
+   the reference graph (any Name/Attribute in a function body that
+   resolves to a known function is an edge; lexically nested functions
+   of a reachable function are reachable).  This over-approximates —
+   a reference passed to ``lax.scan``/``vmap`` is an edge even without
+   a direct call — which is the right bias for a lint gate: reachable
+   code that is *actually* host-only gets a pragma with a reason.
+
+Stdlib ``ast`` only; no imports are executed.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# canonical qualified names that create a jit boundary when they wrap a
+# function.  instrumented_jit (obs/perf.py) is the repo's jax.jit
+# drop-in; its static_argnums/static_argnames kwargs carry the same
+# semantics, so JL003 cross-checks against both uniformly.
+JIT_WRAPPERS = frozenset({
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+    "sagecal_tpu.obs.perf.instrumented_jit",
+})
+
+# wrappers that forward their first argument's body into the trace:
+# jit(shard_map(f)) / jit(vmap(f)) must mark f (and what f references)
+# jit-reachable
+PASSTHROUGH_WRAPPERS = frozenset({
+    "jax.vmap",
+    "jax.pmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.experimental.shard_map.shard_map",
+    "functools.partial",
+})
+
+_PRAGMA_RE = re.compile(
+    r"#\s*jaxlint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+)
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method definition (top-level, nested, or class)."""
+
+    qualname: str  # "<module>.<outer>.<name>"
+    module: str
+    name: str
+    node: ast.AST
+    lineno: int
+    parent: Optional[str] = None  # enclosing function qualname
+    children: List[str] = dataclasses.field(default_factory=list)
+    jit_root: bool = False
+    static_argnames: Set[str] = dataclasses.field(default_factory=set)
+    static_argnums: Set[int] = dataclasses.field(default_factory=set)
+    wrap_sites: List[Tuple[str, int]] = dataclasses.field(
+        default_factory=list)  # (module, lineno) of each jit wrap
+    refs: Set[str] = dataclasses.field(default_factory=set)  # raw dotted
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str  # as discovered (relative to cwd when possible)
+    name: str  # dotted module name
+    tree: Optional[ast.Module]
+    lines: List[str]
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    import_lines: Set[int] = dataclasses.field(default_factory=set)
+    toplevel: Set[str] = dataclasses.field(default_factory=set)
+    functions: Dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    func_by_node: Dict[int, FuncInfo] = dataclasses.field(
+        default_factory=dict)  # id(node) -> FuncInfo
+    pragmas: Dict[int, Set[str]] = dataclasses.field(default_factory=dict)
+    file_pragmas: Set[str] = dataclasses.field(default_factory=set)
+    parse_error: Optional[str] = None
+
+    def enclosing_function(self, node: ast.AST) -> Optional[FuncInfo]:
+        """Innermost function containing ``node`` (via parent links)."""
+        cur = getattr(node, "_jaxlint_parent", None)
+        while cur is not None:
+            fi = self.func_by_node.get(id(cur))
+            if fi is not None:
+                return fi
+            cur = getattr(cur, "_jaxlint_parent", None)
+        return None
+
+
+def qual_of(node: ast.AST, imports: Dict[str, str],
+            toplevel: Optional[Set[str]] = None,
+            module: str = "") -> Optional[str]:
+    """Canonical dotted name of a Name/Attribute chain, alias-expanded.
+
+    ``jnp.where`` -> ``jax.numpy.where``; a module-local top-level name
+    gets the module prefix so it matches the function table."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    head = node.id
+    if head in imports:
+        base = imports[head]
+    elif toplevel is not None and head in toplevel and module:
+        base = f"{module}.{head}"
+    else:
+        base = head
+    parts.append(base)
+    return ".".join(reversed(parts))
+
+
+def _module_name_for(path: str) -> str:
+    """Dotted module name by climbing the package (__init__.py) chain."""
+    path = os.path.abspath(path)
+    d = os.path.dirname(path)
+    stem = os.path.splitext(os.path.basename(path))[0]
+    parts = [] if stem == "__init__" else [stem]
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.insert(0, os.path.basename(d))
+        d = os.path.dirname(d)
+    return ".".join(parts) if parts else stem
+
+
+def _scan_pragmas(lines: Sequence[str]) -> Tuple[Dict[int, Set[str]],
+                                                 Set[str]]:
+    per_line: Dict[int, Set[str]] = {}
+    per_file: Set[str] = set()
+    for i, line in enumerate(lines, start=1):
+        if "jaxlint" not in line:
+            continue
+        for m in _PRAGMA_RE.finditer(line):
+            rules = {r.strip().upper()
+                     for r in m.group("rules").split(",") if r.strip()}
+            if m.group("file"):
+                per_file |= rules
+            else:
+                per_line.setdefault(i, set()).update(rules)
+    return per_line, per_file
+
+
+def _collect_imports(tree: ast.Module, modname: str,
+                     is_pkg_init: bool) -> Tuple[Dict[str, str], Set[int]]:
+    imports: Dict[str, str] = {}
+    import_lines: Set[int] = set()
+    # the package a relative import is relative to
+    pkg_parts = modname.split(".") if is_pkg_init else modname.split(".")[:-1]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            import_lines.add(node.lineno)
+            for a in node.names:
+                if a.asname:
+                    imports[a.asname] = a.name
+                else:
+                    # "import jax.numpy" binds "jax"
+                    imports.setdefault(a.name.split(".")[0],
+                                       a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            import_lines.add(node.lineno)
+            if node.module == "__future__":
+                continue
+            if node.level:
+                base_parts = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                base = ".".join(base_parts + (
+                    node.module.split(".") if node.module else []))
+            else:
+                base = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imports[a.asname or a.name] = (
+                    f"{base}.{a.name}" if base else a.name)
+    return imports, import_lines
+
+
+def _link_parents(tree: ast.Module) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._jaxlint_parent = node
+
+
+class CallGraph:
+    """All analyzed modules + the jit-reachability closure."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}  # dotted name -> info
+        self.modules_by_path: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.reachable: Set[str] = set()
+        # pending jit/passthrough wrap call sites:
+        # (modname, scope_qual, target_expr, statics, lineno)
+        self._wrap_calls: List[tuple] = []
+        # (scope_qual or "", name) -> first-arg expr of a passthrough call
+        self._assign_chain: Dict[Tuple[str, str], ast.AST] = {}
+
+    # ------------------------------------------------------------ build
+    def add_file(self, path: str) -> ModuleInfo:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        lines = source.splitlines()
+        name = _module_name_for(path)
+        per_line, per_file = _scan_pragmas(lines)
+        mi = ModuleInfo(path=path, name=name, tree=None, lines=lines,
+                        pragmas=per_line, file_pragmas=per_file)
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            mi.parse_error = f"{type(e).__name__}: {e.msg} (line {e.lineno})"
+            self._register(mi)
+            return mi
+        mi.tree = tree
+        _link_parents(tree)
+        is_pkg_init = os.path.basename(path) == "__init__.py"
+        mi.imports, mi.import_lines = _collect_imports(tree, name,
+                                                       is_pkg_init)
+        mi.toplevel = {
+            n.name for n in tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef))
+        } | {
+            t.id for n in tree.body if isinstance(n, ast.Assign)
+            for t in n.targets if isinstance(t, ast.Name)
+        }
+        self._collect_functions(mi)
+        self._collect_wraps_and_refs(mi)
+        self._register(mi)
+        return mi
+
+    def _register(self, mi: ModuleInfo) -> None:
+        self.modules[mi.name] = mi
+        self.modules_by_path[mi.path] = mi
+        for q, fi in mi.functions.items():
+            self.functions[q] = fi
+
+    def _collect_functions(self, mi: ModuleInfo) -> None:
+        def visit(node: ast.AST, prefix: str, parent_fn: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = f"{prefix}.{child.name}"
+                    fi = FuncInfo(qualname=q, module=mi.name,
+                                  name=child.name, node=child,
+                                  lineno=child.lineno, parent=parent_fn)
+                    mi.functions[q] = fi
+                    mi.func_by_node[id(child)] = fi
+                    if parent_fn is not None:
+                        mi.functions[parent_fn].children.append(q)
+                    self._check_jit_decorators(mi, fi, child)
+                    visit(child, q, q)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}.{child.name}", parent_fn)
+                else:
+                    visit(child, prefix, parent_fn)
+
+        visit(mi.tree, mi.name, None)
+
+    def _statics_from_keywords(self, keywords) -> Tuple[Set[str], Set[int]]:
+        names: Set[str] = set()
+        nums: Set[int] = set()
+        for kw in keywords or ():
+            if kw.arg == "static_argnames":
+                for el in self._const_elts(kw.value):
+                    if isinstance(el, str):
+                        names.add(el)
+            elif kw.arg == "static_argnums":
+                for el in self._const_elts(kw.value):
+                    if isinstance(el, int):
+                        nums.add(el)
+        return names, nums
+
+    @staticmethod
+    def _const_elts(node: ast.AST) -> List:
+        if isinstance(node, ast.Constant):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return [e.value for e in node.elts
+                    if isinstance(e, ast.Constant)]
+        return []
+
+    def _check_jit_decorators(self, mi: ModuleInfo, fi: FuncInfo,
+                              node) -> None:
+        for dec in node.decorator_list:
+            call = dec if isinstance(dec, ast.Call) else None
+            target = call.func if call is not None else dec
+            q = qual_of(target, mi.imports, mi.toplevel, mi.name)
+            if q in JIT_WRAPPERS:
+                fi.jit_root = True
+                fi.wrap_sites.append((mi.name, dec.lineno))
+                if call is not None:
+                    names, nums = self._statics_from_keywords(call.keywords)
+                    fi.static_argnames |= names
+                    fi.static_argnums |= nums
+            elif (call is not None and q in ("functools.partial", "partial")
+                  and call.args):
+                inner_q = qual_of(call.args[0], mi.imports, mi.toplevel,
+                                  mi.name)
+                if inner_q in JIT_WRAPPERS:
+                    fi.jit_root = True
+                    fi.wrap_sites.append((mi.name, dec.lineno))
+                    names, nums = self._statics_from_keywords(call.keywords)
+                    fi.static_argnames |= names
+                    fi.static_argnums |= nums
+
+    def _collect_wraps_and_refs(self, mi: ModuleInfo) -> None:
+        for node in ast.walk(mi.tree):
+            scope_fi = mi.enclosing_function(node)
+            scope = scope_fi.qualname if scope_fi is not None else ""
+            if isinstance(node, ast.Call):
+                q = qual_of(node.func, mi.imports, mi.toplevel, mi.name)
+                if q in JIT_WRAPPERS and node.args:
+                    names, nums = self._statics_from_keywords(node.keywords)
+                    self._wrap_calls.append(
+                        (mi.name, scope, node.args[0], names, nums,
+                         node.lineno))
+                elif (q in ("functools.partial", "partial")
+                      and len(node.args) >= 2):
+                    inner_q = qual_of(node.args[0], mi.imports, mi.toplevel,
+                                      mi.name)
+                    if inner_q in JIT_WRAPPERS:
+                        names, nums = self._statics_from_keywords(
+                            node.keywords)
+                        self._wrap_calls.append(
+                            (mi.name, scope, node.args[1], names, nums,
+                             node.lineno))
+            elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                # remember `fn = shard_map(local_fit, ...)`-style bindings
+                # so a later jit(fn) chases through to local_fit
+                q = qual_of(node.value.func, mi.imports, mi.toplevel,
+                            mi.name)
+                if q is not None and (
+                        q in PASSTHROUGH_WRAPPERS
+                        or q.split(".")[-1] == "shard_map"):
+                    if node.value.args:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                self._assign_chain[(scope, t.id)] = \
+                                    node.value.args[0]
+            if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                    getattr(node, "ctx", None), ast.Load):
+                if scope_fi is None:
+                    continue
+                q = qual_of(node, mi.imports, mi.toplevel, mi.name)
+                if q:
+                    scope_fi.refs.add(q)
+
+    # ---------------------------------------------------------- resolve
+    def _resolve_target(self, modname: str, scope: str, expr: ast.AST,
+                        depth: int = 0) -> Optional[FuncInfo]:
+        """Resolve a jit-wrap target expression to a FuncInfo, chasing
+        one-level pass-through wrappers (shard_map/vmap/partial)."""
+        if depth > 4:
+            return None
+        mi = self.modules.get(modname)
+        if mi is None:
+            return None
+        if isinstance(expr, ast.Call):
+            q = qual_of(expr.func, mi.imports, mi.toplevel, mi.name)
+            if q is not None and (q in PASSTHROUGH_WRAPPERS
+                                  or q.split(".")[-1] == "shard_map"):
+                if expr.args:
+                    return self._resolve_target(modname, scope, expr.args[0],
+                                                depth + 1)
+            return None
+        q = qual_of(expr, mi.imports, mi.toplevel, mi.name)
+        if q is None:
+            return None
+        fi = self._lookup(q, modname, scope)
+        if fi is not None:
+            return fi
+        # a bare local name bound from a pass-through wrapper call
+        if isinstance(expr, ast.Name):
+            s = scope
+            while True:
+                chained = self._assign_chain.get((s, expr.id))
+                if chained is not None:
+                    return self._resolve_target(modname, s, chained,
+                                                depth + 1)
+                if not s:
+                    break
+                parent = self.functions.get(s)
+                s = parent.parent if parent is not None and parent.parent \
+                    else ""
+        return None
+
+    def _lookup(self, q: str, modname: str, scope: str) -> Optional[FuncInfo]:
+        if q in self.functions:
+            return self.functions[q]
+        # scope-local nested name, walking the enclosing chain out
+        s = scope
+        while s:
+            cand = f"{s}.{q}"
+            if cand in self.functions:
+                return self.functions[cand]
+            parent = self.functions.get(s)
+            s = parent.parent if parent is not None and parent.parent else ""
+        cand = f"{modname}.{q}"
+        return self.functions.get(cand)
+
+    def finalize(self) -> None:
+        """Resolve wrap call-sites, then close reachability."""
+        for modname, scope, expr, names, nums, lineno in self._wrap_calls:
+            fi = self._resolve_target(modname, scope, expr)
+            if fi is None:
+                continue
+            fi.jit_root = True
+            fi.wrap_sites.append((modname, lineno))
+            fi.static_argnames |= names
+            fi.static_argnums |= nums
+        # BFS over reference edges + lexical nesting
+        queue = [q for q, fi in self.functions.items() if fi.jit_root]
+        seen: Set[str] = set()
+        while queue:
+            q = queue.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            fi = self.functions[q]
+            for child in fi.children:
+                if child not in seen:
+                    queue.append(child)
+            for ref in fi.refs:
+                target = self._lookup(ref, fi.module, fi.qualname)
+                if target is not None and target.qualname not in seen:
+                    queue.append(target.qualname)
+        self.reachable = seen
+
+    # ------------------------------------------------------------ query
+    def is_reachable(self, fi: Optional[FuncInfo]) -> bool:
+        return fi is not None and fi.qualname in self.reachable
+
+    def stmt_reachable(self, mi: ModuleInfo, node: ast.AST) -> \
+            Optional[FuncInfo]:
+        """The innermost *jit-reachable* function containing ``node``
+        (itself or any lexical ancestor), or None."""
+        fi = mi.enclosing_function(node)
+        while fi is not None:
+            if fi.qualname in self.reachable:
+                return fi
+            fi = self.functions.get(fi.parent) if fi.parent else None
+        return None
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted list of .py files."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if not d.startswith(".") and d != "__pycache__")
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def build_callgraph(files: Sequence[str]) -> CallGraph:
+    g = CallGraph()
+    for f in files:
+        g.add_file(f)
+    g.finalize()
+    return g

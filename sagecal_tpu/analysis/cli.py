@@ -1,0 +1,128 @@
+"""jaxlint command line: argument parsing, baseline gate, exit codes.
+
+Exit codes: 0 clean (or all findings baselined / report-only), 1 new
+findings, 2 usage error.  Reached three ways with identical semantics:
+
+- ``python -m sagecal_tpu.analysis [paths...]``
+- ``python tools/jaxlint.py [paths...]``
+- ``sagecal-tpu diag lint [paths...]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from sagecal_tpu.analysis import baseline as baseline_mod
+from sagecal_tpu.analysis import engine
+
+DEFAULT_BASELINE = "jaxlint_baseline.json"
+
+
+def _default_paths() -> List[str]:
+    """Lint the installed package when invoked with no paths."""
+    import sagecal_tpu
+
+    return [os.path.dirname(os.path.abspath(sagecal_tpu.__file__))]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="AST-based JAX-discipline analyzer for sagecal-tpu",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to lint "
+                        "(default: the sagecal_tpu package)")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="report format (default: text)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline JSON of grandfathered findings "
+                        f"(default: ./{DEFAULT_BASELINE} if present)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="write current findings to the baseline file "
+                        "and exit 0")
+    p.add_argument("--rules", default=None, metavar="IDS",
+                   help="comma-separated rule ids to run "
+                        "(default: all, e.g. JL001,JL004)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def _select_rules(spec: Optional[str]):
+    rules = engine.default_rules()
+    if spec is None:
+        return rules
+    wanted = {r.strip().upper() for r in spec.split(",") if r.strip()}
+    known = {r.id for r in rules}
+    unknown = wanted - known
+    if unknown:
+        raise SystemExit(
+            f"jaxlint: unknown rule id(s): {', '.join(sorted(unknown))} "
+            f"(known: {', '.join(sorted(known))})")
+    return [r for r in rules if r.id in wanted]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for r in engine.default_rules():
+            tag = " [report-only]" if r.report_only else ""
+            print(f"{r.id}  {r.title}{tag}")
+        return 0
+
+    try:
+        rules = _select_rules(args.rules)
+    except SystemExit as e:
+        print(e, file=sys.stderr)
+        return 2
+
+    paths = list(args.paths) or _default_paths()
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"jaxlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    findings, stats, _graph = engine.analyze_paths(paths, rules)
+
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.isfile(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+
+    if args.update_baseline:
+        out = args.baseline or DEFAULT_BASELINE
+        baseline_mod.save_baseline(out, findings)
+        n = sum(1 for f in findings if not f.report_only)
+        print(f"jaxlint: wrote {n} finding(s) to {out}")
+        return 0
+
+    bl = baseline_mod.load_baseline(baseline_path) if baseline_path \
+        else None
+    if bl is not None:
+        new, old = baseline_mod.partition(findings, bl)
+        new_keys = {f.key() for f in new}
+        n_baselined = len(old)
+    else:
+        new = [f for f in findings if not f.report_only]
+        new_keys, n_baselined = None, 0
+
+    if args.format == "json":
+        print(engine.format_json(findings, stats, new_keys, n_baselined))
+    else:
+        print(engine.format_text(findings, stats, new_keys, n_baselined))
+
+    return 1 if new else 0
+
+
+def run(argv: Optional[Sequence[str]] = None) -> int:
+    """Console entry: :func:`main`, but a closed stdout pipe
+    (``jaxlint ... | head``) exits 141 instead of a traceback."""
+    try:
+        return main(argv if argv is not None else sys.argv[1:])
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 141

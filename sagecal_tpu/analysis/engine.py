@@ -1,0 +1,263 @@
+"""Rule engine: run rules over the call graph, apply pragmas, report.
+
+A :class:`Finding` is identified for baseline purposes by
+``(rule, path, symbol, message)`` — deliberately *without* the line
+number, so unrelated edits above a grandfathered finding don't churn
+the baseline.  Suppression is per-line: a ``# jaxlint: disable=RULE``
+comment on the flagged line (reasons after an em-dash are encouraged
+and ignored by the parser), or ``# jaxlint: disable-file=RULE``
+anywhere for whole-file suppression.  ``# noqa`` on the flagged line
+also suppresses the report-only JL900 (matching flake8 convention for
+re-export imports).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import time
+from typing import Iterable, Iterator, List, Optional, Sequence, Set
+
+from sagecal_tpu.analysis.callgraph import (
+    CallGraph,
+    ModuleInfo,
+    build_callgraph,
+    collect_files,
+    qual_of,
+)
+
+# canonical prefixes of traced-array-producing namespaces: a call into
+# any of these yields a tracer inside jit-reachable code
+JNP_CALL_PREFIXES = (
+    "jax.numpy.",
+    "jax.lax.",
+    "jax.scipy.",
+    "jax.nn.",
+    "jax.random.",
+    "jax.tree_util.",
+)
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    symbol: str = ""
+    report_only: bool = False
+
+    def key(self):
+        """Baseline identity (line-independent, see module doc)."""
+        return (self.rule, _posix(self.path), self.symbol, self.message)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": _posix(self.path), "line": self.line,
+            "col": self.col, "message": self.message, "symbol": self.symbol,
+            "report_only": self.report_only,
+        }
+
+
+def _posix(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+class Rule:
+    """Base class: one diagnostic, one module, fixture-tested."""
+
+    id = "JL000"
+    title = ""
+    report_only = False
+
+    def check(self, graph: CallGraph) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, mi: ModuleInfo, node: ast.AST, message: str,
+                symbol: str = "") -> Finding:
+        return Finding(
+            path=mi.path, line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0), rule=self.id,
+            message=message, symbol=symbol, report_only=self.report_only,
+        )
+
+
+# --------------------------------------------------- shared AST helpers
+
+
+def iter_calls(node: ast.AST) -> Iterator[ast.Call]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+def is_jnp_call(call: ast.Call, mi: ModuleInfo) -> bool:
+    q = qual_of(call.func, mi.imports, mi.toplevel, mi.name)
+    return q is not None and q.startswith(JNP_CALL_PREFIXES)
+
+
+def contains_jnp_call(node: ast.AST, mi: ModuleInfo,
+                      tainted: Optional[Set[str]] = None) -> bool:
+    """True when any sub-expression calls into a jnp/lax namespace or
+    reads a local known to hold a traced value."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and is_jnp_call(n, mi):
+            # jnp.real(x).dtype and friends are static metadata reads
+            parent = getattr(n, "_jaxlint_parent", None)
+            if isinstance(parent, ast.Attribute) and parent.attr in (
+                    "shape", "dtype", "ndim", "size", "sharding"):
+                continue
+            return True
+        if (tainted and isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load) and n.id in tainted):
+            # x.shape / x.dtype / x.ndim are static at trace time —
+            # reading them off a traced local is legal Python
+            parent = getattr(n, "_jaxlint_parent", None)
+            if isinstance(parent, ast.Attribute) and parent.attr in (
+                    "shape", "dtype", "ndim", "size", "sharding"):
+                continue
+            return True
+    return False
+
+
+def tainted_locals(fn_node: ast.AST, mi: ModuleInfo) -> Set[str]:
+    """Local names assigned (directly) from jnp/lax-calling expressions
+    — a one-level, no-fixpoint taint that keeps precision high: static
+    config locals never enter, so ``if collect_trace:`` stays legal."""
+    tainted: Set[str] = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Assign) and contains_jnp_call(n.value, mi):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for el in t.elts:
+                        if isinstance(el, ast.Name):
+                            tainted.add(el.id)
+        elif isinstance(n, ast.AugAssign) and isinstance(
+                n.target, ast.Name) and contains_jnp_call(n.value, mi):
+            tainted.add(n.target.id)
+    return tainted
+
+
+def path_segments(path: str) -> Set[str]:
+    return set(_posix(path).split("/"))
+
+
+# --------------------------------------------------------------- engine
+
+
+def default_rules() -> List[Rule]:
+    from sagecal_tpu.analysis.rules import all_rules
+
+    return [cls() for cls in all_rules()]
+
+
+def _suppressed(f: Finding, graph: CallGraph) -> bool:
+    mi = graph.modules_by_path.get(f.path)
+    if mi is None:
+        return False
+    if f.rule in mi.file_pragmas or "ALL" in mi.file_pragmas:
+        return True
+    tags = mi.pragmas.get(f.line, ())
+    if f.rule in tags or "ALL" in tags:
+        return True
+    if f.report_only and f.line <= len(mi.lines) \
+            and "# noqa" in mi.lines[f.line - 1]:
+        return True
+    return False
+
+
+def analyze_paths(paths: Sequence[str],
+                  rules: Optional[Sequence[Rule]] = None):
+    """Run the rules over ``paths``.  Returns ``(findings, stats)``:
+    pragma-suppressed findings are already removed; baseline handling is
+    the caller's (cli.py)."""
+    t0 = time.perf_counter()
+    files = collect_files(paths)
+    graph = build_callgraph(files)
+    rules = list(rules) if rules is not None else default_rules()
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(graph))
+    kept = sorted(f for f in findings if not _suppressed(f, graph))
+    parse_errors = [
+        Finding(path=mi.path, line=1, col=0, rule="JL000",
+                message=f"could not parse: {mi.parse_error}")
+        for mi in graph.modules.values() if mi.parse_error
+    ]
+    stats = {
+        "files": len(files),
+        "jit_roots": sum(1 for fi in graph.functions.values()
+                         if fi.jit_root),
+        "jit_reachable": len(graph.reachable),
+        "elapsed_seconds": round(time.perf_counter() - t0, 3),
+        "rules": [r.id for r in rules],
+    }
+    return sorted(parse_errors) + kept, stats, graph
+
+
+# -------------------------------------------------------------- reports
+
+
+def format_text(findings: Iterable[Finding], stats: dict,
+                new_keys: Optional[Set] = None,
+                baselined: int = 0) -> str:
+    lines = []
+    for f in findings:
+        mark = ""
+        if f.report_only:
+            mark = " [report-only]"
+        elif new_keys is not None and f.key() not in new_keys:
+            mark = " [baselined]"
+        sym = f" in `{f.symbol.split('.')[-1]}`" if f.symbol else ""
+        lines.append(
+            f"{_posix(f.path)}:{f.line}:{f.col}: {f.rule} {f.message}"
+            f"{sym}{mark}"
+        )
+    fs = list(findings)
+    n_report = sum(1 for f in fs if f.report_only)
+    n_gate = len(fs) - n_report
+    n_new = len(new_keys) if new_keys is not None else n_gate
+    lines.append(
+        f"jaxlint: {n_gate} finding(s) ({n_new} new, {baselined} "
+        f"baselined) + {n_report} report-only over {stats['files']} "
+        f"file(s), {stats['jit_reachable']} jit-reachable function(s), "
+        f"{stats['elapsed_seconds']}s"
+    )
+    if n_new:
+        lines.append(
+            "fix each finding, or suppress a deliberate one with "
+            "`# jaxlint: disable=RULE — reason`, or grandfather with "
+            "--update-baseline"
+        )
+    return "\n".join(lines)
+
+
+def format_json(findings: Iterable[Finding], stats: dict,
+                new_keys: Optional[Set] = None,
+                baselined: int = 0) -> str:
+    fs = list(findings)
+    recs = []
+    for f in fs:
+        d = f.to_dict()
+        if new_keys is not None and not f.report_only:
+            d["new"] = f.key() in new_keys
+        recs.append(d)
+    n_report = sum(1 for f in fs if f.report_only)
+    n_gate = len(fs) - n_report
+    payload = {
+        "version": 1,
+        "findings": recs,
+        "summary": {
+            "total": n_gate,
+            "new": len(new_keys) if new_keys is not None else n_gate,
+            "baselined": baselined,
+            "report_only": n_report,
+        },
+        "stats": stats,
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)

@@ -1,0 +1,31 @@
+"""Rule registry: one module per rule, discovered statically.
+
+Adding a rule = add a module here, list its class in ``all_rules``,
+give it fixtures in ``tests/fixtures/jaxlint/`` and cases in
+``tests/test_analysis.py``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Type
+
+from sagecal_tpu.analysis.engine import Rule
+from sagecal_tpu.analysis.rules.jl001 import TracedControlFlow
+from sagecal_tpu.analysis.rules.jl002 import HostSync
+from sagecal_tpu.analysis.rules.jl003 import RecompileHazard
+from sagecal_tpu.analysis.rules.jl004 import DtypePolicy
+from sagecal_tpu.analysis.rules.jl005 import DataDependentShape
+from sagecal_tpu.analysis.rules.jl006 import StrayCollective
+from sagecal_tpu.analysis.rules.jl900 import DeadImport
+
+
+def all_rules() -> List[Type[Rule]]:
+    return [
+        TracedControlFlow,
+        HostSync,
+        RecompileHazard,
+        DtypePolicy,
+        DataDependentShape,
+        StrayCollective,
+        DeadImport,
+    ]

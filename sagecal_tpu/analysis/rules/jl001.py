@@ -1,0 +1,68 @@
+"""JL001: Python control flow on traced values inside jit-reachable code.
+
+``if``/``while``/``assert`` with a test that calls into ``jax.numpy`` /
+``jax.lax`` (or reads a local assigned from such a call) forces a trace
+-time concretization error at best, a silent host sync at worst.  The
+fix is ``jnp.where`` / ``lax.cond`` / ``lax.while_loop``.
+
+Precision: only *jnp-tainted* tests fire.  ``if collect_trace:`` on a
+static bool, ``if key is None``, and dtype comparisons are all legal
+trace-time Python and stay silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from sagecal_tpu.analysis.engine import (
+    Finding,
+    Rule,
+    contains_jnp_call,
+    tainted_locals,
+)
+
+
+def _identity_test(test: ast.AST) -> bool:
+    """``x is None`` / ``x is not None`` — object identity is legal
+    trace-time Python even when ``x`` may hold a tracer."""
+    if isinstance(test, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in test.ops)
+    if isinstance(test, ast.BoolOp):
+        return all(_identity_test(v) for v in test.values)
+    return False
+
+
+class TracedControlFlow(Rule):
+    id = "JL001"
+    title = ("Python if/while/assert on a traced value inside "
+             "jit-reachable code")
+
+    def check(self, graph) -> Iterator[Finding]:
+        for mi in graph.modules.values():
+            if mi.tree is None:
+                continue
+            taint_cache = {}
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, (ast.If, ast.While, ast.Assert)):
+                    continue
+                fi = graph.stmt_reachable(mi, node)
+                if fi is None:
+                    continue
+                if fi.qualname not in taint_cache:
+                    taint_cache[fi.qualname] = tainted_locals(fi.node, mi)
+                tainted = taint_cache[fi.qualname]
+                test = node.test
+                if _identity_test(test):
+                    continue
+                if not contains_jnp_call(test, mi, tainted):
+                    continue
+                kind = {ast.If: "if", ast.While: "while",
+                        ast.Assert: "assert"}[type(node)]
+                yield self.finding(
+                    mi, node,
+                    f"Python `{kind}` on a traced value "
+                    f"(use jnp.where / lax.cond / lax.while_loop)",
+                    symbol=fi.qualname,
+                )

@@ -1,0 +1,82 @@
+"""JL002: host-sync calls reachable from jitted code.
+
+``.item()`` / ``.tolist()`` / ``.block_until_ready()`` / ``np.asarray``
+/ ``jax.device_get`` inside a jit-reachable function either fail at
+trace time or (worse, via callbacks) silently round-trip device->host.
+
+Builtin casts (``float()``/``int()``/``bool()``/``complex()``) are only
+flagged when the argument is jnp-tainted: ``float(fdelta)`` on a Python
+closure scalar (sage.py's coherency block) is legal and common, while
+``float(jnp.sum(r))`` inside jit is a concretization error.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from sagecal_tpu.analysis.engine import (
+    Finding,
+    Rule,
+    contains_jnp_call,
+    tainted_locals,
+)
+from sagecal_tpu.analysis.callgraph import qual_of
+
+_SYNC_ATTRS = ("item", "tolist", "block_until_ready")
+_SYNC_QUALS = ("jax.device_get",)
+# flagged only when the argument is jnp-tainted: np.array([...python
+# floats...]) is a legal trace-time constant, np.asarray(traced) syncs
+_TAINTED_ONLY_QUALS = ("numpy.asarray", "numpy.array")
+_CAST_BUILTINS = ("float", "int", "bool", "complex")
+
+
+class HostSync(Rule):
+    id = "JL002"
+    title = "host-sync call reachable from jitted code"
+
+    def check(self, graph) -> Iterator[Finding]:
+        for mi in graph.modules.values():
+            if mi.tree is None:
+                continue
+            taint_cache = {}
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fi = graph.stmt_reachable(mi, node)
+                if fi is None:
+                    continue
+                msg = self._classify(node, mi, fi, taint_cache)
+                if msg:
+                    yield self.finding(mi, node, msg, symbol=fi.qualname)
+
+    def _classify(self, call, mi, fi, taint_cache):
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr in _SYNC_ATTRS:
+            # skip list.item()-style on plain dicts: only flag when the
+            # receiver is a Name/Attribute/Call (array-like receiver is
+            # undecidable statically; .item/.block_until_ready are
+            # array-API names so the prior is strong)
+            return (f"`.{func.attr}()` forces a device->host sync "
+                    f"inside jit-reachable code")
+        q = qual_of(func, mi.imports, mi.toplevel, mi.name)
+        if q in _SYNC_QUALS:
+            return (f"`{q}` materializes a device array on host "
+                    f"inside jit-reachable code")
+        if q in _TAINTED_ONLY_QUALS and call.args:
+            if fi.qualname not in taint_cache:
+                taint_cache[fi.qualname] = tainted_locals(fi.node, mi)
+            if contains_jnp_call(call.args[0], mi,
+                                 taint_cache[fi.qualname]):
+                return (f"`{q}` on a traced value forces a "
+                        f"device->host sync inside jit-reachable code")
+            return None
+        if (isinstance(func, ast.Name) and func.id in _CAST_BUILTINS
+                and func.id not in mi.imports and call.args):
+            if fi.qualname not in taint_cache:
+                taint_cache[fi.qualname] = tainted_locals(fi.node, mi)
+            if contains_jnp_call(call.args[0], mi,
+                                 taint_cache[fi.qualname]):
+                return (f"`{func.id}()` on a traced value concretizes "
+                        f"inside jit-reachable code")
+        return None

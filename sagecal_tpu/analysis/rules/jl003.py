@@ -1,0 +1,97 @@
+"""JL003: recompile hazards — branch-controlling parameters of a jit
+root not declared static.
+
+A jit-wrapped function whose parameter (bool-annotated or bool-default)
+is used in a Python ``if``/ternary test must declare that parameter in
+``static_argnames``/``static_argnums`` — otherwise every call traces it
+as a 0-d array and the branch fails, or (when callers pass weak-typed
+Python scalars) each distinct value recompiles.  Statics are merged
+across every wrap site of the function (decorator and call-site forms,
+``jax.jit`` and ``instrumented_jit`` alike), so declaring them on any
+wrapper satisfies the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from sagecal_tpu.analysis.engine import Finding, Rule
+
+
+def _bool_like_params(node) -> Set[str]:
+    """Parameter names annotated ``bool`` or defaulted to True/False."""
+    args = node.args
+    out: Set[str] = set()
+    all_args = list(args.posonlyargs) + list(args.args) + list(
+        args.kwonlyargs)
+    for a in all_args:
+        ann = a.annotation
+        if isinstance(ann, ast.Name) and ann.id == "bool":
+            out.add(a.arg)
+        elif (isinstance(ann, ast.Constant)
+              and ann.value in ("bool", "Bool")):
+            out.add(a.arg)
+    pos = list(args.posonlyargs) + list(args.args)
+    for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+        if isinstance(d, ast.Constant) and isinstance(d.value, bool):
+            out.add(a.arg)
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if d is not None and isinstance(d, ast.Constant) \
+                and isinstance(d.value, bool):
+            out.add(a.arg)
+    return out
+
+
+def _positions(node) -> dict:
+    args = node.args
+    return {a.arg: i for i, a in enumerate(
+        list(args.posonlyargs) + list(args.args))}
+
+
+class RecompileHazard(Rule):
+    id = "JL003"
+    title = ("jit parameter drives a Python branch but is not in "
+             "static_argnames/static_argnums")
+
+    def check(self, graph) -> Iterator[Finding]:
+        for fi in graph.functions.values():
+            if not fi.jit_root:
+                continue
+            mi = graph.modules.get(fi.module)
+            if mi is None or mi.tree is None:
+                continue
+            candidates = _bool_like_params(fi.node)
+            if not candidates:
+                continue
+            positions = _positions(fi.node)
+            declared = set(fi.static_argnames)
+            declared |= {name for name, pos in positions.items()
+                         if pos in fi.static_argnums}
+            used = self._branch_params(fi.node)
+            for name in sorted((candidates & used) - declared):
+                yield self.finding(
+                    mi, fi.node,
+                    f"jit parameter `{name}` drives a Python branch but "
+                    f"is not declared static (add it to static_argnames "
+                    f"at the jit wrap site)",
+                    symbol=fi.qualname,
+                )
+
+    @staticmethod
+    def _branch_params(node) -> Set[str]:
+        """Names read inside if/ternary/while tests or boolean ops."""
+        used: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, (ast.If, ast.IfExp, ast.While)):
+                tests = [n.test]
+            elif isinstance(n, ast.BoolOp):
+                tests = n.values
+            else:
+                continue
+            for t in tests:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name) and isinstance(
+                            sub.ctx, ast.Load):
+                        used.add(sub.id)
+        return used

@@ -1,0 +1,73 @@
+"""JL004: 64-bit dtype promotions inside the precision-policy layers.
+
+The TPU port's policy (utils/precision.py) is float32/complex64 in the
+compute layers — ``ops/``, ``solvers/``, ``parallel/``.  An
+*unconditional* ``jnp.float64`` / ``jnp.complex128`` reference there
+either silently downgrades (x64 disabled) or doubles HBM traffic and
+kills MXU throughput (x64 enabled).
+
+Precision: only unconditional ``jax.numpy`` 64-bit dtypes fire.  The
+repo's deliberate x64-aware idiom —
+
+    ctype = jnp.complex64 if u.dtype == jnp.float32 else jnp.complex128
+
+— selects the dtype *conditionally* (inside an ``IfExp`` or an
+``if``-statement) and stays silent, as do host-side ``np.float64``
+precomputations.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from sagecal_tpu.analysis.engine import Finding, Rule, path_segments
+from sagecal_tpu.analysis.callgraph import qual_of
+
+_POLICY_SEGMENTS = {"ops", "solvers", "parallel"}
+_WIDE = {
+    "jax.numpy.float64", "jax.numpy.complex128", "jax.numpy.int64",
+    "jax.numpy.uint64",
+}
+
+
+def _under_conditional(node: ast.AST) -> bool:
+    cur = getattr(node, "_jaxlint_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.IfExp, ast.If)):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Module)):
+            return False
+        cur = getattr(cur, "_jaxlint_parent", None)
+    return False
+
+
+class DtypePolicy(Rule):
+    id = "JL004"
+    title = ("unconditional 64-bit jnp dtype inside the "
+             "float32/complex64 policy layers (ops/solvers/parallel)")
+
+    def check(self, graph) -> Iterator[Finding]:
+        for mi in graph.modules.values():
+            if mi.tree is None:
+                continue
+            if not (_POLICY_SEGMENTS & path_segments(mi.path)):
+                continue
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Attribute):
+                    continue
+                q = qual_of(node, mi.imports, mi.toplevel, mi.name)
+                if q not in _WIDE:
+                    continue
+                if _under_conditional(node):
+                    continue
+                fi = mi.enclosing_function(node)
+                yield self.finding(
+                    mi, node,
+                    f"unconditional `{q.replace('jax.numpy', 'jnp')}` "
+                    f"breaks the float32/complex64 policy (select the "
+                    f"wide dtype conditionally on the input dtype, or "
+                    f"keep it out of the compute layers)",
+                    symbol=fi.qualname if fi else "",
+                )

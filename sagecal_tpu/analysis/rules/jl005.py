@@ -1,0 +1,63 @@
+"""JL005: data-dependent output shapes inside jit-reachable code.
+
+``jnp.nonzero`` / ``jnp.unique`` / boolean-mask indexing produce shapes
+that depend on array *values* — untraceable under jit without a static
+``size=`` escape hatch.  The fix is ``jnp.where`` with fill values, a
+fixed-size mask-and-weight formulation (how robust.py keeps the
+whole-tile residual resident), or ``size=``/``fill_value=``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from sagecal_tpu.analysis.engine import Finding, Rule
+from sagecal_tpu.analysis.callgraph import qual_of
+
+_DDS_FUNCS = {
+    "jax.numpy.nonzero", "jax.numpy.flatnonzero", "jax.numpy.argwhere",
+    "jax.numpy.unique", "jax.numpy.compress", "jax.numpy.extract",
+}
+
+
+class DataDependentShape(Rule):
+    id = "JL005"
+    title = "data-dependent output shape inside jit-reachable code"
+
+    def check(self, graph) -> Iterator[Finding]:
+        for mi in graph.modules.values():
+            if mi.tree is None:
+                continue
+            for node in ast.walk(mi.tree):
+                msg = self._classify(node, mi)
+                if msg is None:
+                    continue
+                fi = graph.stmt_reachable(mi, node)
+                if fi is None:
+                    continue
+                yield self.finding(mi, node, msg, symbol=fi.qualname)
+
+    def _classify(self, node, mi):
+        if isinstance(node, ast.Call):
+            q = qual_of(node.func, mi.imports, mi.toplevel, mi.name)
+            if q in _DDS_FUNCS:
+                if any(kw.arg == "size" for kw in node.keywords):
+                    return None  # static size= escape hatch
+                short = q.replace("jax.numpy", "jnp")
+                return (f"`{short}` has a data-dependent output shape "
+                        f"under jit (pass size=/fill_value=, or use a "
+                        f"fixed-size mask formulation)")
+            if q == "jax.numpy.where" and len(node.args) == 1 \
+                    and not node.keywords:
+                return ("one-argument `jnp.where` has a data-dependent "
+                        "output shape under jit (use the three-argument "
+                        "form or pass size=)")
+        elif isinstance(node, ast.Subscript):
+            # x[mask] / x[y > 0]: boolean-mask indexing
+            sl = node.slice
+            if isinstance(sl, ast.Compare):
+                return ("boolean-mask indexing has a data-dependent "
+                        "output shape under jit (use jnp.where with a "
+                        "fill value instead)")
+        return None

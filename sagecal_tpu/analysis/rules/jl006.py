@@ -1,0 +1,56 @@
+"""JL006: collectives outside the parallel layer.
+
+Per the "Unwrapping ADMM" layering, the consensus loop is
+communication-only: ``jax.lax.psum`` and friends belong in
+``parallel/`` (and the shard_map boundary in ``solvers/sharded.py``).
+A collective anywhere else couples compute kernels to a mesh axis —
+unrunnable single-device, untestable in isolation.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator
+
+from sagecal_tpu.analysis.engine import Finding, Rule, path_segments
+from sagecal_tpu.analysis.callgraph import qual_of
+
+_COLLECTIVES = {
+    "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+    "jax.lax.ppermute", "jax.lax.pshuffle", "jax.lax.all_gather",
+    "jax.lax.all_to_all", "jax.lax.axis_index", "jax.lax.psum_scatter",
+}
+_ALLOWED_SEGMENT = "parallel"
+_ALLOWED_BASENAMES = {"sharded.py"}
+
+
+class StrayCollective(Rule):
+    id = "JL006"
+    title = ("jax.lax collective outside parallel/ and "
+             "solvers/sharded.py")
+
+    def check(self, graph) -> Iterator[Finding]:
+        for mi in graph.modules.values():
+            if mi.tree is None:
+                continue
+            if _ALLOWED_SEGMENT in path_segments(mi.path):
+                continue
+            if os.path.basename(mi.path) in _ALLOWED_BASENAMES:
+                continue
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                q = qual_of(node.func, mi.imports, mi.toplevel, mi.name)
+                if q not in _COLLECTIVES:
+                    continue
+                fi = mi.enclosing_function(node)
+                short = q.rsplit(".", 1)[-1]
+                yield self.finding(
+                    mi, node,
+                    f"collective `lax.{short}` outside the parallel "
+                    f"layer (move it to parallel/ or "
+                    f"solvers/sharded.py; compute kernels must stay "
+                    f"mesh-free)",
+                    symbol=fi.qualname if fi else "",
+                )

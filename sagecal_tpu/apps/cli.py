@@ -243,6 +243,7 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     _warn_dropped_fused(args)
     cfg = config_from_args(args)
+    from sagecal_tpu.obs.contracts import ContractViolation
     from sagecal_tpu.obs.quality import DivergenceAbort
 
     try:
@@ -252,6 +253,12 @@ def main(argv=None):
         # run_aborted event; exit distinctly from argparse's 2
         print(f"sagecal-tpu: {e}", file=sys.stderr)
         return 3
+    except ContractViolation as e:
+        # SAGECAL_CHECKIFY=1: a NaN/div/index contract tripped inside a
+        # jitted solver; the contract_violation event is already in the
+        # JSONL log (apps drain it before re-raising)
+        print(f"sagecal-tpu: {e}", file=sys.stderr)
+        return 4
 
 
 def _dispatch(args, cfg) -> int:

@@ -538,8 +538,11 @@ def _run_distributed_inner(
       log(f"phases: {timer.run_summary()}")
       audit.__exit__(None, None, None)
       if elog is not None:
+          from sagecal_tpu.obs.contracts import emit_contract_events
+
           emit_perf_events(elog)
           audit.emit(elog)
+          emit_contract_events(elog)
           elog.emit("run_done", n_tiles=len(traces),
                     phase_totals=dict(timer.totals))
           elog.close()

@@ -10,7 +10,6 @@ streams tiles and files.
 
 from __future__ import annotations
 
-import sys
 import time
 from typing import Optional
 
@@ -210,6 +209,10 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
     # first-class profiling (SURVEY section 5): per-phase wall-clock
     # always on; SAGECAL_PROFILE_DIR additionally captures an XLA trace
     # and SAGECAL_TRANSFER_AUDIT=1 logs implicit host<->device transfers
+    from sagecal_tpu.obs.contracts import (
+        ContractViolation,
+        emit_contract_events,
+    )
     from sagecal_tpu.obs.perf import (
         TransferAudit,
         dump_memory_profile,
@@ -436,6 +439,17 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
         )
         results.append((res0, res1))
 
+    except ContractViolation as e:
+        # SAGECAL_CHECKIFY contract tripped mid-solve: flush the
+        # structured contract_violation event + a run_aborted marker
+        # into the log before the CLI maps the exception to exit 4
+        if elog is not None:
+            emit_contract_events(elog)
+            elog.emit("run_aborted", reason="contract_violation",
+                      fn=e.fn_name, detail=e.detail)
+            elog.close()
+            elog = None
+        raise
     finally:
         # always reap the worker thread + its read handle, even when the
         # solve/write raises mid-loop; same for the transfer audit (its
@@ -447,6 +461,9 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
     if elog is not None:
         emit_perf_events(elog)
         audit.emit(elog)
+        # contract_unsupported markers (checkify skipped a wrapper) are
+        # worth keeping even in clean runs
+        emit_contract_events(elog)
         elog.emit("run_done", n_tiles=len(results),
                   phase_totals=dict(timer.totals))
         elog.close()

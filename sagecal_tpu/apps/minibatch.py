@@ -15,7 +15,6 @@ workers (minibatch_consensus_mode.cpp:359-363,455-606).
 from __future__ import annotations
 
 import time
-from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -298,6 +297,7 @@ def _run_minibatch(cfg: RunConfig, log, audit):
             elog.emit("band_residual", band=bi, res0=r0, res1=r1)
         log(f"band {bi}: residual {r0:.4f} -> {r1:.4f}")
     if elog is not None:
+        from sagecal_tpu.obs.contracts import emit_contract_events
         from sagecal_tpu.obs.perf import emit_perf_events
 
         # close the audit now (idempotent; the shell's exit is then a
@@ -305,6 +305,7 @@ def _run_minibatch(cfg: RunConfig, log, audit):
         audit.__exit__(None, None, None)
         emit_perf_events(elog)
         audit.emit(elog)
+        emit_contract_events(elog)
         elog.emit("run_done", n_bands=len(bands))
         elog.close()
 

@@ -43,7 +43,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from flax import struct
 
 # Speed of light (m/s), used to convert metre uvw to wavelengths (the

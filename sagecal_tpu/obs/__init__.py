@@ -13,6 +13,10 @@ Four pieces (SURVEY section 5 "observability"):
 - :mod:`sagecal_tpu.obs.perf` — performance observability:
   ``instrumented_jit`` compile/recompile tracking, device-memory
   watermarks, the transfer-guard audit, and the bench regression gate.
+- :mod:`sagecal_tpu.obs.contracts` — opt-in ``SAGECAL_CHECKIFY=1``
+  runtime contracts: checkify NaN/div/index checks on every
+  ``instrumented_jit`` entry, surfaced as ``contract_violation``
+  events (CLI exit 4).
 - :mod:`sagecal_tpu.obs.diag` — the ``sagecal-tpu diag`` CLI.
 
 This package root imports neither jax nor numpy (obs.perf defers its
@@ -35,6 +39,12 @@ from sagecal_tpu.obs.events import (  # noqa: F401
     default_event_log,
     read_events,
     validate_manifest,
+)
+from sagecal_tpu.obs.contracts import (  # noqa: F401
+    ContractViolation,
+    checkify_enabled,
+    drain_contract_events,
+    emit_contract_events,
 )
 from sagecal_tpu.obs.perf import (  # noqa: F401
     TransferAudit,
@@ -82,6 +92,10 @@ __all__ = [
     "default_event_log",
     "read_events",
     "validate_manifest",
+    "ContractViolation",
+    "checkify_enabled",
+    "drain_contract_events",
+    "emit_contract_events",
     "TransferAudit",
     "device_memory_snapshot",
     "dump_memory_profile",

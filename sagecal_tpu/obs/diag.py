@@ -33,6 +33,12 @@ Subcommands:
   ``solver_diverged`` event); ``--fail-degraded`` also fails on
   degradation (station outliers, heavy down-weighting).
 
+- ``lint [paths...] [--format json|text] [--baseline FILE]`` — the
+  jaxlint static-analysis gate (:mod:`sagecal_tpu.analysis`): JL001-
+  JL006 JAX-discipline rules + the report-only JL900 dead-import sweep
+  over the given paths (default: the installed ``sagecal_tpu``).
+  Exit 1 on new (non-baselined) findings.
+
 Runs standalone (``python -m sagecal_tpu.obs.diag ...``) or via the
 ``diag`` subcommand of the main CLI (:mod:`sagecal_tpu.apps.cli`).
 """
@@ -314,6 +320,14 @@ def _cmd_quality(args) -> int:
     return 0
 
 
+def _cmd_lint(args) -> int:
+    # the jaxlint package is import-light by design (stdlib ast only):
+    # deferring keeps `diag manifest` usable before backend selection
+    from sagecal_tpu.analysis.cli import main as lint_main
+
+    return lint_main(args.lint_args)
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="sagecal-tpu diag",
@@ -375,6 +389,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="exit non-zero on degradation too, not just "
                          "divergence")
     qp.set_defaults(fn=_cmd_quality)
+
+    lp = sub.add_parser(
+        "lint",
+        help="jaxlint static-analysis gate (JL001-JL006 + JL900)",
+    )
+    lp.add_argument("lint_args", nargs=argparse.REMAINDER,
+                    help="arguments forwarded to jaxlint "
+                         "(paths, --format, --baseline, --rules, ...); "
+                         "default lints the installed sagecal_tpu")
+    lp.set_defaults(fn=_cmd_lint)
     return ap
 
 

@@ -44,7 +44,6 @@ selection.
 
 from __future__ import annotations
 
-import contextlib
 import hashlib
 import os
 import sys
@@ -181,6 +180,11 @@ class _InstrumentedJit:
         self._fn = fn
         self.name = name or getattr(fn, "__name__", repr(fn))
         self._jitted = jax.jit(fn, **jit_kwargs)
+        # kept for the SAGECAL_CHECKIFY contract path, which rebuilds
+        # the jit around checkify(fn) with the same static declarations
+        self._jit_kwargs = dict(jit_kwargs)
+        self._checked = None
+        self._checkify_broken = False
         self._static_argnums = frozenset(
             int(i) for i in _as_tuple(jit_kwargs.get("static_argnums"))
         )
@@ -248,6 +252,30 @@ class _InstrumentedJit:
         return compiled
 
     def __call__(self, *args, **kwargs):
+        # contract path first: SAGECAL_CHECKIFY must catch NaNs even in
+        # runs with telemetry off.  Only at the outermost entry: when
+        # this wrapper is reached from inside another trace (jit/vmap of
+        # a caller), the checkify error value would itself be a tracer
+        # and err.get() cannot run — the outer checked entry already
+        # covers those frames.
+        from sagecal_tpu.obs import contracts
+
+        if contracts.checkify_active() and not self._checkify_broken:
+            try:
+                if self._checked is None:
+                    self._checked = contracts.checked_jit(
+                        self._fn, self._jit_kwargs)
+                err, out = self._checked(*args, **kwargs)
+            except Exception as e:
+                # checkify cannot wrap everything (Pallas kernels,
+                # donated buffers, exotic shardings): record once, then
+                # permanently route this wrapper unchecked
+                self._checkify_broken = True
+                self._checked = None
+                contracts.note_unsupported(self.name, repr(e))
+            else:
+                contracts.raise_if_error(err, self.name)
+                return out
         if not telemetry_enabled():
             return self._jitted(*args, **kwargs)
         sig = self._sig_key(args, kwargs)

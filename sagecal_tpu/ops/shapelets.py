@@ -25,7 +25,6 @@ Math (verified against shapelet.c:49-188):
 from __future__ import annotations
 
 import math
-from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -106,7 +106,6 @@ def admm_sagefit(
         if use_rtr or use_nsd:
             from sagecal_tpu.solvers.rtr import (
                 RTRConfig,
-                nsd_solve,
                 nsd_solve_robust,
                 rtr_solve,
                 rtr_solve_robust,

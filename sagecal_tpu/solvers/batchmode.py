@@ -16,13 +16,13 @@ hand-writes threaded gradients, robust_lbfgs.c:155+).
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from sagecal_tpu.core.types import VisData
-from sagecal_tpu.solvers.lbfgs import LBFGSMemory, LBFGSResult, lbfgs_fit
+from sagecal_tpu.solvers.lbfgs import LBFGSMemory, lbfgs_fit
 from sagecal_tpu.solvers.sage import ClusterData, predict_full_model
 from sagecal_tpu.utils.precision import true_f32
 

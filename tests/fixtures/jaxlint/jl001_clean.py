@@ -1,0 +1,42 @@
+"""JL001 must-not-fire fixture: legal trace-time Python control flow."""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("collect_trace",))
+def static_branch(x, collect_trace: bool = False):
+    y = jnp.abs(x)
+    if collect_trace:  # static Python bool: retrace, not a tracer leak
+        y = y * 2.0
+    return y
+
+
+@jax.jit
+def identity_checks(x, key: Optional[jax.Array] = None):
+    if key is None:  # `is None` is object identity, always legal
+        key = jax.random.PRNGKey(0)
+    r = jnp.sum(x)
+    if r is not None:  # tainted local, but still an identity check
+        x = x + 1.0
+    return x, key
+
+
+@jax.jit
+def metadata_checks(x):
+    if jnp.real(x).dtype == jnp.float32:  # .dtype is static metadata
+        x = x * 2.0
+    y = jnp.abs(x)
+    if y.shape[0] > 3:  # .shape on a tainted local is static too
+        y = y[:3]
+    return y
+
+
+def host_only(x):
+    # not jit-reachable from anywhere: plain Python branching is fine
+    if jnp.sum(x) > 0:
+        return 1
+    return 0

@@ -1,0 +1,25 @@
+"""JL001 should-fire fixture: Python branch on a traced value in jit."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_branch(x):
+    r = jnp.sum(jnp.abs(x))
+    if r > 1.0:  # JL001: traced comparison in Python `if`
+        return x / r
+    return x
+
+
+@jax.jit
+def bad_while(x):
+    while jnp.max(x) > 1.0:  # JL001
+        x = x * 0.5
+    return x
+
+
+@jax.jit
+def bad_assert(x):
+    assert jnp.all(jnp.isfinite(x))  # JL001
+    return x

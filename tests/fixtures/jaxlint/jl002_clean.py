@@ -1,0 +1,22 @@
+"""JL002 must-not-fire fixture: legal casts and host-side syncs."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def closure_cast(x, fdelta=1e5):
+    # float() on a plain Python scalar is legal inside jit
+    scale = float(fdelta) / 2.0
+    # np.array on a Python-list constant folds into the trace
+    norm = np.array([math.sqrt(n + 1.0) for n in range(4)])
+    return x * scale + jnp.asarray(norm, x.dtype).sum()
+
+
+def host_driver(x):
+    # not jit-reachable: syncing on the host boundary is the point
+    out = jax.jit(jnp.sum)(x)
+    return float(out.block_until_ready())

@@ -1,0 +1,31 @@
+"""JL002 should-fire fixture: host syncs reachable from jitted code."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def leaf(x):
+    return float(jnp.sum(x))  # JL002: float() on a traced value
+
+
+def middle(x):
+    s = jnp.abs(x)
+    return leaf(s) + s.item()  # JL002: .item() device->host sync
+
+
+@jax.jit
+def entry(x):
+    # `middle` (and through it `leaf`) is jit-reachable from here
+    return middle(x)
+
+
+@jax.jit
+def materialize(x):
+    y = jnp.exp(x)
+    return np.asarray(y)  # JL002: np.asarray on a traced value
+
+
+@jax.jit
+def blocker(x):
+    return jnp.sum(x).block_until_ready()  # JL002

@@ -1,0 +1,33 @@
+"""JL003 must-not-fire fixture: statics declared, or no branching."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def plain(x, normalize: bool = False):
+    # bool param never drives a Python branch: jnp.where is traced
+    return jnp.where(normalize, x / jnp.sum(x), x)
+
+
+def fit(x, collect_trace: bool = False, robust: bool = False):
+    y = jnp.sum(x * x)
+    if robust:
+        y = jnp.sqrt(y)
+    return (y, y) if collect_trace else (y, None)
+
+
+# statics declared at the wrap site: both branch drivers covered
+fit_jit = jax.jit(fit, static_argnames=("collect_trace", "robust"))
+
+
+@jax.jit
+def positional(x, mode: bool = True):
+    if mode:
+        return x + 1.0
+    return x - 1.0
+
+
+# declared by position on a second wrap site of the same function:
+# statics merge across wrap sites
+positional_jit = jax.jit(positional, static_argnums=(1,))

@@ -1,0 +1,20 @@
+"""JL003 should-fire fixture: branch-controlling jit params not static."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit  # JL003: `robust` drives a branch but is not declared static
+def solve(x, robust: bool = False):
+    if robust:
+        return jnp.median(x)
+    return jnp.mean(x)
+
+
+def fit(x, collect_trace: bool = False):
+    y = jnp.sum(x * x)
+    return (y, y) if collect_trace else (y, None)
+
+
+# JL003: call-site wrap without static_argnames for collect_trace
+fit_jit = jax.jit(fit)

@@ -1,0 +1,21 @@
+"""JL005 must-not-fire fixture: fixed-shape formulations."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def masked_sum(vis, mask):
+    # fixed-size mask-and-weight form: shape never depends on values
+    return jnp.sum(jnp.where(mask, vis, 0.0))
+
+
+@jax.jit
+def sized_nonzero(mask):
+    # static size= escape hatch keeps the shape fixed
+    return jnp.nonzero(mask, size=8, fill_value=0)
+
+
+def host_side(freqs):
+    # not jit-reachable: data-dependent shapes are fine on the host
+    return jnp.unique(freqs)

@@ -1,0 +1,25 @@
+"""JL005 should-fire fixture: data-dependent shapes inside jit."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pick_flagged(vis, mask):
+    idx = jnp.nonzero(mask)  # JL005: value-dependent output shape
+    return vis[idx]
+
+
+@jax.jit
+def dedupe(freqs):
+    return jnp.unique(freqs)  # JL005
+
+
+@jax.jit
+def where_one_arg(w):
+    return jnp.where(w > 0)  # JL005: one-argument where
+
+
+@jax.jit
+def boolean_mask(x):
+    return x[x > 0]  # JL005: boolean-mask indexing

@@ -1,0 +1,16 @@
+"""JL006 should-fire fixture: collective outside the parallel layer
+(this file deliberately lives outside parallel/ and is not sharded.py).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def local_residual(r):
+    total = lax.psum(jnp.sum(r * r), axis_name="band")  # JL006
+    return r / total
+
+
+def who_am_i():
+    return jax.lax.axis_index("band")  # JL006

@@ -1,0 +1,12 @@
+"""JL900 fixture: dead imports, with the honored escape hatches."""
+
+import json  # JL900: unused
+import os  # noqa: F401  (kept: re-export convention)
+import sys
+from typing import List, Optional  # JL900: Optional unused
+
+__all__ = ["sys", "use_list"]
+
+
+def use_list(xs: List[int]) -> int:
+    return len(xs)

@@ -1,0 +1,31 @@
+"""Call-graph fixture: reachability through the repo's real wrap forms
+(instrumented_jit call-site wrap, shard_map pass-through chasing,
+decorator factories), plus a function that must stay unreachable."""
+
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+
+from sagecal_tpu.obs.perf import instrumented_jit
+
+
+def helper(x):
+    return jnp.sum(x * x)
+
+
+def local_fit(x):
+    return helper(x) + 1.0
+
+
+# solvers/sharded.py idiom: jit(shard_map(f)) must mark f reachable
+fn = shard_map(local_fit, mesh=None, in_specs=None, out_specs=None)
+fit_jit = instrumented_jit(fn, name="fixture.fit")
+
+
+@instrumented_jit(name="fixture.block")
+def block(x):
+    return helper(x) * 2.0
+
+
+def host_only_report(x):
+    # referenced by nothing jitted: must NOT be jit-reachable
+    return str(x)

@@ -1,0 +1,19 @@
+"""Pragma fixture: every finding here carries a suppression comment,
+so this file must come out clean."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def suppressed_branch(x):
+    r = jnp.sum(x)
+    if r > 0:  # jaxlint: disable=JL001 — fixture: deliberate branch
+        return x / r
+    return x
+
+
+def suppressed_collective(r):
+    # jaxlint: disable-file=JL006
+    return lax.psum(r, axis_name="band")

@@ -1,0 +1,23 @@
+"""JL004 must-not-fire fixture: the repo's x64-aware conditional idiom."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def widen_conditionally(u):
+    # the deliberate idiom: wide dtype only when the input is wide
+    ctype = jnp.complex64 if u.dtype == jnp.float32 else jnp.complex128
+    return u.astype(ctype)
+
+
+def statement_form(u):
+    if u.dtype == jnp.float64:
+        out = jnp.zeros(u.shape, jnp.complex128)
+    else:
+        out = jnp.zeros(u.shape, jnp.complex64)
+    return out
+
+
+def host_precompute(n):
+    # numpy 64-bit on host is outside the device precision policy
+    return np.zeros(n, np.float64)

@@ -1,0 +1,12 @@
+"""JL004 should-fire fixture (lives under a solvers/ path segment)."""
+
+import jax.numpy as jnp
+
+
+def accumulate(x):
+    acc = jnp.zeros(x.shape, jnp.float64)  # JL004: unconditional f64
+    return acc + x
+
+
+def widen(u):
+    return u.astype(jnp.complex128)  # JL004: unconditional c128

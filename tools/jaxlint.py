@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""jaxlint console entry point.
+
+Equivalent to ``python -m sagecal_tpu.analysis`` and
+``sagecal-tpu diag lint``; exists so the lint gate runs from a bare
+checkout without installing the CLI (CI, pre-commit hooks)::
+
+    python tools/jaxlint.py sagecal_tpu/ --format json
+    python tools/jaxlint.py --list-rules
+    python tools/jaxlint.py sagecal_tpu/ --update-baseline
+
+Exit codes: 0 clean/baselined, 1 new findings, 2 usage error.
+"""
+
+import os
+import sys
+
+# bare-checkout support: make the adjacent package importable
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from sagecal_tpu.analysis.cli import run  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -27,6 +27,14 @@ step() {  # step <name> <timeout> <cmd...>
   manifest "$name"
 }
 export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+# static-analysis gate first: pure-CPU AST pass (<10 s), no accelerator
+# needed, so a JAX-discipline regression stops the run before any TPU
+# time is spent (non-baselined JL* finding = hard stop)
+echo "=== jaxlint static-analysis gate"
+if ! JAX_PLATFORMS=cpu timeout 120 python -m sagecal_tpu.obs.diag lint \
+    sagecal_tpu/; then
+  echo "LINT GATE FAILED (new jaxlint findings) - stop"; exit 1
+fi
 step bisect-c 200 python kbisect.py c
 step bisect-b 200 python kbisect.py b
 step bisect-a 200 python kbisect.py a
