@@ -414,7 +414,25 @@ def _measure_cpu_subprocess(tilesz=TILESZ, timeout=1800.0):
     return None
 
 
+def _latest_flight_dump():
+    """Newest flight-recorder dump matching the configured dump path, so
+    the recovery event links straight to the forensics artifact."""
+    import glob
+
+    base = os.environ.get("SAGECAL_FLIGHT_DUMP", "flight_dump.json")
+    root, ext = os.path.splitext(base)
+    cands = sorted(set(glob.glob(base) + glob.glob(root + "*" + ext)))
+    if not cands:
+        return None
+    try:
+        return os.path.abspath(max(cands, key=os.path.getmtime))
+    except OSError:
+        return os.path.abspath(cands[-1])
+
+
 def main():
+    import uuid
+
     import jax
 
     # persistent compile cache: a prior successful TPU compile (e.g. the
@@ -424,6 +442,25 @@ def main():
         os.environ.get("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache"),
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    # crash forensics + tracing for the bench itself: heartbeat while the
+    # (possibly wedged-tunnel) TPU work runs, stall dump if it hangs.
+    # The run_id is minted here and handed to the manifest later so the
+    # span file and the event log correlate.
+    from sagecal_tpu.obs.flight import (
+        close_flight_recorder,
+        get_flight_recorder,
+        install_crash_handlers,
+        register_event_log,
+        unregister_event_log,
+    )
+    from sagecal_tpu.obs.trace import close_tracer, configure_tracer, get_tracer
+
+    run_id = uuid.uuid4().hex[:12]
+    install_crash_handlers()
+    get_flight_recorder(run_id=run_id)
+    configure_tracer(run_id=run_id)
+    tracer = get_tracer()
 
     probe_ok = _probe_default_backend()
     probe_failed_initially = not probe_ok
@@ -478,9 +515,11 @@ def main():
     on_tpu = platform not in ("cpu",)
     tilesz = TILESZ if on_tpu else 5
     repeats = REPEATS if on_tpu else 1
-    value, iters, dt, perf = run(
-        np.float32, repeats=repeats, want_flops=True, tilesz=tilesz
-    )
+    with tracer.span("bench", kind="run", platform=platform,
+                     tilesz=tilesz, repeats=repeats):
+        value, iters, dt, perf = run(
+            np.float32, repeats=repeats, want_flops=True, tilesz=tilesz
+        )
     xla_flops = perf.get("flops")
 
     cpu_measured = None
@@ -587,12 +626,15 @@ def main():
 
     elog = default_event_log(manifest=RunManifest.collect(
         kernel_path="fused" if FUSED else "xla", app="bench",
+        run_id=run_id,
     ))
     if elog is not None:
+        register_event_log(elog)
         if probe_failed_initially:
             elog.emit("tpu_probe_failed", recovered=probe_ok)
         if recovery_attempted:
-            elog.emit("tpu_recovery_attempted", succeeded=probe_ok)
+            elog.emit("tpu_recovery_attempted", succeeded=probe_ok,
+                      flight_dump=_latest_flight_dump())
         if not probe_ok or init_failed:
             elog.emit("fallback_to_cpu", platform=platform,
                       backend_init_failed=init_failed)
@@ -601,6 +643,11 @@ def main():
         emit_perf_events(elog)
         elog.emit("bench_result", **rec)
         elog.close()
+        unregister_event_log(elog)
+    close_tracer()
+    # success path only: leaves the final "closed" heartbeat; a crash
+    # keeps the recorder alive for the excepthook's dump
+    close_flight_recorder()
     print(json.dumps(rec))
 
 

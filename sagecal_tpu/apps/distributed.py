@@ -94,6 +94,66 @@ def _check_band_consistency(metas, log):
     return min(m.ntime for m in metas)
 
 
+def _emit_admm_attribution(tracer, elog, log, t0, admm_seconds,
+                           admm_start_unix, fratios, nf, nadmm, nslots,
+                           plain_emiter, max_emiter):
+    """Host-side straggler attribution for one tile's mesh ADMM window.
+
+    The whole nadmm loop is ONE jitted shard_map dispatch, so per-band /
+    per-round wall time is not observable from the host; instead the
+    measured dispatch->block window is distributed over per-band work
+    weights (unflagged-row fractions — the same fratio that scales rho)
+    and the static per-round work model
+    (:func:`sagecal_tpu.parallel.admm.round_work_weights`) as SYNTHETIC
+    child spans that sum exactly to the window.  Straggler gauges
+    (slowest/median ratio, skew) + a ``straggler_detected`` event fire
+    on the attributed seconds."""
+    from sagecal_tpu.obs.registry import get_registry
+    from sagecal_tpu.obs.trace import band_attribution, straggler_stats
+    from sagecal_tpu.parallel.admm import round_work_weights
+
+    weights = [float(f) for f in fratios[:nf]]
+    band_secs = band_attribution(admm_seconds, weights)
+    stats = straggler_stats(band_secs)
+    if tracer.enabled:
+        admm_id = tracer.add_span(
+            "admm", admm_seconds, start_unix=admm_start_unix,
+            kind="admm", tile=t0, nadmm=nadmm, nf=nf)
+        rsecs = band_attribution(
+            admm_seconds,
+            round_work_weights(nadmm, nslots, plain_emiter, max_emiter))
+        r_start = admm_start_unix
+        for r, s in enumerate(rsecs):
+            tracer.add_span("admm.round", s, parent_id=admm_id,
+                            start_unix=r_start, round=r, tile=t0,
+                            synthetic=True, attribution="round-work-model")
+            r_start += s
+        for b, s in enumerate(band_secs):
+            tracer.add_span("admm.band", s, parent_id=admm_id,
+                            start_unix=admm_start_unix, band=b, tile=t0,
+                            lane=f"band{b}", synthetic=True,
+                            attribution="unflagged-rows")
+    reg = get_registry()
+    for b, s in enumerate(band_secs):
+        reg.gauge_set("admm_band_seconds", s,
+                      help="attributed per-band seconds of the last "
+                           "mesh ADMM window", band=str(b))
+    reg.gauge_set("admm_straggler_ratio", stats["ratio"],
+                  help="slowest/median attributed band seconds of the "
+                       "last mesh ADMM window")
+    reg.gauge_set("admm_band_skew", stats["skew"],
+                  help="(max-mean)/mean attributed band seconds")
+    if stats["detected"]:
+        if elog is not None:
+            elog.emit("straggler_detected", tile=t0, band=stats["argmax"],
+                      ratio=stats["ratio"], skew=stats["skew"],
+                      band_seconds=band_secs,
+                      threshold=stats["threshold"])
+        log(f"tile {t0}: straggler band {stats['argmax']} "
+            f"({stats['ratio']:.2f}x median attributed work)")
+    return band_secs, stats
+
+
 def run_distributed(
     cfg: RunConfig,
     datasets: Optional[Sequence[str]] = None,
@@ -292,11 +352,32 @@ def _run_distributed_inner(
         spatial=spatial,
         collect_trace=collect,
     )
-    elog = default_event_log(manifest=RunManifest.collect(
+    manifest = RunManifest.collect(
         app="distributed", bands=Nf, nadmm=nadmm,
         solver_mode=cfg.solver_mode, n_clusters=M, n_stations=N,
         adaptive_rho=adaptive_rho,
-    ))
+    )
+    elog = default_event_log(manifest=manifest)
+    # crash forensics + tracing (obs/flight.py, obs/trace.py): the
+    # excepthook/SIGTERM handlers flush the event log with run_aborted,
+    # the flight recorder heartbeats for the watch scripts, and the
+    # tracer correlates spans with the manifest's run_id
+    from sagecal_tpu.obs.flight import (
+        close_flight_recorder,
+        get_flight_recorder,
+        install_crash_handlers,
+        note_activity,
+        register_event_log,
+        unregister_event_log,
+    )
+    from sagecal_tpu.obs.trace import close_tracer, configure_tracer, get_tracer
+
+    install_crash_handlers()
+    if elog is not None:
+        register_event_log(elog)
+    get_flight_recorder(run_id=manifest.run_id)
+    configure_tracer(run_id=manifest.run_id)
+    tracer = get_tracer()
 
     # solution files: global Z + per-band J (slave :959-979 analog);
     # every handle is registered with the caller's finally-block
@@ -415,6 +496,11 @@ def _run_distributed_inner(
 
     pf_iters = []
     zdiff_carry = None
+    # root span for the whole run; manual enter so the existing
+    # try/finally owns the exit (tile + phase spans nest under it)
+    run_span = tracer.span("distributed", kind="run", bands=Nf, ndev=ndev,
+                           nadmm=nadmm)
+    run_span.__enter__()
     try:
       pf_iters = [iter(pf.__enter__()) for pf in prefetchers]
       prepared = None
@@ -423,6 +509,8 @@ def _run_distributed_inner(
             prepared = _prepare_tile(pairs[0][1], None)
       for pi, (tile_no, t0) in enumerate(pairs):
         tic = time.time()
+        tile_span = tracer.span("tile", kind="tile", tile=t0)
+        tile_span.__enter__()
         datas, cdatas, fratios_lazy = prepared
         # sync the lazy per-band unflagged fractions NOW (the previous
         # tile's solve has been consumed, the queue is free)
@@ -431,6 +519,8 @@ def _run_distributed_inner(
         rho = jnp.asarray(
             np.asarray(fratios)[:, None] * rho_m[None, :], dtype
         )
+        admm_start_unix = time.time()
+        t_dispatch = time.perf_counter()
         with timer.phase("dispatch"):
             out = fn(
                 stack_for_mesh(datas), stack_for_mesh(cdatas),
@@ -444,6 +534,18 @@ def _run_distributed_inner(
         if pi + 1 < len(pairs):
             with timer.phase("prepare"):
                 prepared = _prepare_tile(pairs[pi + 1][1], zdiff_carry)
+        # close the ADMM device window AFTER the overlap work: this is
+        # the first sync on tile t's outputs, so dispatch->here is the
+        # tile's measured mesh-ADMM wall-time, attributed to synthetic
+        # per-band / per-round child spans + straggler gauges
+        with timer.phase("solve-wait"):
+            out = jax.block_until_ready(out)
+        admm_seconds = time.perf_counter() - t_dispatch
+        band_secs, straggler = _emit_admm_attribution(
+            tracer, elog, log, t0, admm_seconds, admm_start_unix,
+            fratios, Nf, nadmm, Nf_pad // ndev,
+            max(cfg.max_emiter, 2), cfg.max_emiter)
+        note_activity("tile", name=f"tile{t0}", seconds=admm_seconds)
         if mdl:
             # AIC/MDL consensus-order scan on this tile's rho-scaled
             # solutions (the master's -M path at admm==0,
@@ -503,6 +605,8 @@ def _run_distributed_inner(
                 primal_res=np.asarray(out.primal_res),
                 dual_res=np.asarray(out.dual_res),
                 seconds=time.time() - tic,
+                admm_seconds=admm_seconds, band_seconds=band_secs,
+                straggler_ratio=straggler["ratio"],
                 phase_seconds=timer.tile_timings(), **extra,
             )
         if out.primal_res_band is not None:
@@ -535,6 +639,7 @@ def _run_distributed_inner(
             f"{float(out.primal_res[-1]):.3e} ({time.time()-tic:.1f}s) "
             f"[{timer.tile_summary()}]"
         )
+        tile_span.__exit__(None, None, None)
       log(f"phases: {timer.run_summary()}")
       audit.__exit__(None, None, None)
       if elog is not None:
@@ -546,6 +651,7 @@ def _run_distributed_inner(
           elog.emit("run_done", n_tiles=len(traces),
                     phase_totals=dict(timer.totals))
           elog.close()
+          unregister_event_log(elog)
       # end-of-run spatial-model amplitude plot (the master's PPM
       # output, sagecal_master.cpp:1198 / pngoutput.c) from the final
       # tile's Zspat — shapelet basis only (the plot evaluates the
@@ -568,5 +674,11 @@ def _run_distributed_inner(
             pf.__exit__(None, None, None)
         audit.__exit__(None, None, None)
         trace_cm.__exit__(None, None, None)
+        run_span.__exit__(None, None, None)
+        # writes the Chrome trace (trace.json) alongside the span JSONL
+        close_tracer()
 
+    # success path only: a raise above must leave the recorder (ring)
+    # alive for the excepthook's forensic dump
+    close_flight_recorder()
     return traces

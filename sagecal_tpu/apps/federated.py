@@ -115,6 +115,32 @@ def _run_inner(cfg, datasets, handles, open_files, log, nadmm, epochs,
     freqs = np.asarray([m.freq0 for m in metas])
     freq0 = float(np.mean(freqs))
 
+    # telemetry + crash forensics (the federated driver joins the same
+    # event-log / span / heartbeat lifecycle as the other apps)
+    from sagecal_tpu.obs import RunManifest, default_event_log
+    from sagecal_tpu.obs.flight import (
+        close_flight_recorder,
+        get_flight_recorder,
+        install_crash_handlers,
+        note_activity,
+        register_event_log,
+        unregister_event_log,
+    )
+    from sagecal_tpu.obs.trace import close_tracer, configure_tracer, get_tracer
+
+    manifest = RunManifest.collect(
+        app="federated", bands=Nf, nadmm=nadmm, epochs=epochs,
+        minibatches=minibatches, solver_mode=cfg.solver_mode,
+        n_stations=N,
+    )
+    elog = default_event_log(manifest=manifest)
+    install_crash_handlers()
+    if elog is not None:
+        register_event_log(elog)
+    get_flight_recorder(run_id=manifest.run_id)
+    configure_tracer(run_id=manifest.run_id)
+    tracer = get_tracer()
+
     clusters, cdefs, shapelets = load_sky(
         cfg.sky_model, cfg.cluster_file, meta0.ra0, meta0.dec0, dtype=dtype,
         three_term_spectra=None if cfg.sky_format < 0 else bool(cfg.sky_format),
@@ -163,8 +189,13 @@ def _run_inner(cfg, datasets, handles, open_files, log, nadmm, epochs,
 
     from sagecal_tpu.parallel.mesh import stack_for_mesh
 
+    run_span = tracer.span("federated", kind="run", bands=Nf,
+                           nadmm=nadmm, epochs=epochs)
+    run_span.__enter__()
     for t0 in range(0, ntime, cfg.tilesz):
         tic = time.time()
+        tile_span = tracer.span("tile", kind="tile", tile=t0)
+        tile_span.__enter__()
         eff = min(cfg.tilesz, ntime - t0)
         # minibatch time-slices of this tile; per-band loads + cdata
         slices = [(t0 + s, min(tmb, t0 + eff - (t0 + s)))
@@ -184,6 +215,11 @@ def _run_inner(cfg, datasets, handles, open_files, log, nadmm, epochs,
         resets_total = 0
         cost0 = None
         for admm in range(nadmm):
+            # real per-round span: the np.asarray(cost) below syncs the
+            # round's device work, so the measured window is honest
+            round_span = tracer.span("fed.round", kind="admm_round",
+                                     round=admm, tile=t0)
+            round_span.__enter__()
             for ep in range(epochs):
                 for mb, (dst, cst) in enumerate(mb_data):
                     state, dres, cost = step_fn(dst, cst, state, rho, B)
@@ -204,6 +240,9 @@ def _run_inner(cfg, datasets, handles, open_files, log, nadmm, epochs,
             for b in np.nonzero(bad)[0]:
                 log(f"tile {t0} round {admm}: band {b} diverged "
                     f"(cost {cost_np[b]:.3e}) - reset")
+                if elog is not None:
+                    elog.emit("band_reset", tile=t0, round=admm,
+                              band=int(b), cost=float(cost_np[b]))
                 state = _reset_band(state, int(b), p_init)
                 cost0[b] = np.inf  # re-base on the next finite cost
                 resets_total += 1
@@ -211,13 +250,33 @@ def _run_inner(cfg, datasets, handles, open_files, log, nadmm, epochs,
                 # stochastic_master.cpp:360
                 log(f"tile {t0} round {admm}: Most bands did not "
                     f"converge ({int(bad.sum())}/{Nf} reset)")
+            round_span.__exit__(None, None, None)
+            if elog is not None:
+                elog.emit("fed_round", tile=t0, round=admm,
+                          dual_res=dres_trace[-1] if dres_trace else None,
+                          resets=int(bad.sum()))
         for i in range(Nf):
             jsol = np.asarray(params_to_jones(state.p[i])).reshape(
                 M * nchunk_max, N, 2, 2
             )
             solio.append_solutions(band_fhs[i], jsol)
             band_fhs[i].flush()
+        note_activity("tile", name=f"tile{t0}", seconds=time.time() - tic)
+        tile_span.__exit__(None, None, None)
+        if elog is not None:
+            elog.emit("tile_done", tile=t0, resets=resets_total,
+                      dual_res=dres_trace[-1] if dres_trace else None,
+                      seconds=time.time() - tic)
         log(f"tile {t0}: dual {dres_trace[-1]:.3e} "
             f"resets {resets_total} ({time.time() - tic:.1f}s)")
         results.append((np.asarray(dres_trace), resets_total))
+    run_span.__exit__(None, None, None)
+    close_tracer()
+    if elog is not None:
+        elog.emit("run_done", n_tiles=len(results))
+        elog.close()
+        unregister_event_log(elog)
+    # success path only: leaves the final "closed" heartbeat; a crash
+    # keeps the recorder alive for the excepthook's dump
+    close_flight_recorder()
     return results

@@ -174,12 +174,32 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
         # telemetry records them OR the run must be able to abort
         collect_quality=telemetry_enabled() or cfg.abort_on_divergence,
     )
-    elog = default_event_log(manifest=RunManifest.collect(
+    manifest = RunManifest.collect(
         kernel_path="fused" if scfg.use_fused_predict else "xla",
         app="fullbatch", dataset=cfg.dataset, solver_mode=cfg.solver_mode,
         tilesz=cfg.tilesz, n_clusters=M, n_stations=N,
         simulation_mode=cfg.simulation_mode,
-    ))
+    )
+    elog = default_event_log(manifest=manifest)
+    # crash forensics + tracing: excepthook/SIGTERM flush the event log
+    # (run_aborted + flight-dump path), the flight recorder heartbeats
+    # for the watch scripts, spans correlate on the manifest run_id
+    from sagecal_tpu.obs.flight import (
+        close_flight_recorder,
+        get_flight_recorder,
+        install_crash_handlers,
+        note_activity,
+        register_event_log,
+        unregister_event_log,
+    )
+    from sagecal_tpu.obs.trace import close_tracer, configure_tracer, get_tracer
+
+    install_crash_handlers()
+    if elog is not None:
+        register_event_log(elog)
+    get_flight_recorder(run_id=manifest.run_id)
+    configure_tracer(run_id=manifest.run_id)
+    tracer = get_tracer()
 
     sol_fh = None
     if cfg.simulation_mode == 0:
@@ -252,6 +272,9 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
     # raw read.
     prefetch_cm = TilePrefetcher(cfg.dataset, [t0 for _, t0 in pairs],
                                  specs, cfg.tilesz, depth=1)
+    # root span of the run; manual enter — the try/finally owns the exit
+    run_span = tracer.span("fullbatch", kind="run", tiles=len(pairs))
+    run_span.__enter__()
     try:
       prefetch = iter(prefetch_cm.__enter__())
 
@@ -284,6 +307,8 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
               prepared = _prepare(pairs[0][1])
       for pi, (tile_no, t0) in enumerate(pairs):
         tic = time.time()
+        tile_span = tracer.span("tile", kind="tile", tile=t0)
+        tile_span.__enter__()
         full, data, cdata_full, cdata = prepared
 
         if cfg.simulation_mode:
@@ -311,6 +336,7 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
                           seconds=time.time() - tic,
                           phase_seconds=timer.tile_timings())
             log(f"tile {t0}: simulated ({time.time()-tic:.1f}s)")
+            tile_span.__exit__(None, None, None)
             continue
 
         if cfg.whiten:
@@ -384,6 +410,7 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
             log(f"tile {t0}: influence diagnostics written "
                 f"({time.time()-tic:.1f}s)")
             results.append((float(out.res_0), float(out.res_1)))
+            tile_span.__exit__(None, None, None)
             continue
 
         if cfg.per_channel and meta.nchan > 1:
@@ -438,6 +465,8 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
             f"[{timer.tile_summary()}]"
         )
         results.append((res0, res1))
+        note_activity("tile", name=f"tile{t0}", seconds=time.time() - tic)
+        tile_span.__exit__(None, None, None)
 
     except ContractViolation as e:
         # SAGECAL_CHECKIFY contract tripped mid-solve: flush the
@@ -457,6 +486,8 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
         prefetch_cm.__exit__(None, None, None)
         audit.__exit__(None, None, None)
         trace_cm.__exit__(None, None, None)
+        run_span.__exit__(None, None, None)
+        close_tracer()  # writes trace.json alongside the span JSONL
     log(timer.run_summary())
     if elog is not None:
         emit_perf_events(elog)
@@ -467,8 +498,12 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
         elog.emit("run_done", n_tiles=len(results),
                   phase_totals=dict(timer.totals))
         elog.close()
+        unregister_event_log(elog)
     dump_memory_profile()
     if sol_fh:
         sol_fh.close()
     ds.close()
+    # success path only: leaves the final "closed" heartbeat; a crash
+    # keeps the recorder alive for the excepthook's dump
+    close_flight_recorder()
     return results

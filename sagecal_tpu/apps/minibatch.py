@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import time
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -130,11 +131,36 @@ def _run_minibatch(cfg: RunConfig, log, audit):
     # band primal residuals land in the JSONL event log
     from sagecal_tpu.obs import RunManifest, default_event_log
 
-    elog = default_event_log(manifest=RunManifest.collect(
+    manifest = RunManifest.collect(
         app="minibatch", bands=len(bands), epochs=cfg.epochs,
         minibatches=nb, consensus=consensus_mode,
         solver_mode=cfg.solver_mode, n_clusters=M, n_stations=N,
-    ))
+    )
+    elog = default_event_log(manifest=manifest)
+    # crash forensics + tracing (same lifecycle as the other apps):
+    # excepthook/SIGTERM flush the event log, the flight recorder
+    # heartbeats, spans correlate on the manifest run_id
+    from sagecal_tpu.obs.flight import (
+        close_flight_recorder,
+        get_flight_recorder,
+        install_crash_handlers,
+        note_activity,
+        register_event_log,
+        unregister_event_log,
+    )
+    from sagecal_tpu.obs.trace import (
+        close_tracer,
+        configure_tracer,
+        get_tracer,
+        straggler_stats,
+    )
+
+    install_crash_handlers()
+    if elog is not None:
+        register_event_log(elog)
+    get_flight_recorder(run_id=manifest.run_id)
+    configure_tracer(run_id=manifest.run_id)
+    tracer = get_tracer()
 
     def solve_band(bi, data_band, cdata_band):
         p1, mem1 = bfgsfit_minibatch(
@@ -144,12 +170,19 @@ def _run_minibatch(cfg: RunConfig, log, audit):
         )
         return p1, mem1
 
+    run_span = tracer.span("minibatch", kind="run", bands=len(bands),
+                           epochs=max(cfg.epochs, 1), minibatches=nb,
+                           consensus=consensus_mode)
+    run_span.__enter__()
     for epoch in range(max(cfg.epochs, 1)):
         for mb in range(nb):
             t0, t1 = int(tedges[mb]), int(tedges[mb + 1])
             if t1 <= t0:
                 continue
             tic = time.time()
+            mb_span = tracer.span("batch", kind="batch", epoch=epoch,
+                                  minibatch=mb)
+            mb_span.__enter__()
             full = ds.load_tile(t0, t1 - t0, average_channels=False,
                                 min_uvcut=cfg.min_uvcut,
                                 max_uvcut=cfg.max_uvcut, dtype=dtype,
@@ -176,19 +209,36 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                 track = (cfg.verbose or elog is not None
                          or cfg.abort_on_divergence)
                 pres_traj, dual_traj = [], []
+                # unlike the mesh ADMM (one jitted program, synthetic
+                # attribution) this per-band loop IS host-visible, so
+                # band spans are REAL wall times; blocking per band only
+                # when tracing is on keeps the traced timings honest and
+                # the untraced path's dispatch pipelining untouched
+                band_secs = [0.0] * len(bands)
                 for admm in range(cfg.admm_iters):
                     Z_old = Z
                     zacc = jnp.zeros((M, cfg.npoly, nchunk_max * 8 * N), dtype)
+                    round_span = tracer.span("admm.round",
+                                             kind="admm_round", round=admm,
+                                             epoch=epoch, minibatch=mb)
+                    round_span.__enter__()
                     for bi in range(len(bands)):
                         BZ = consensus.bz_for_freq(
                             Z, jnp.asarray(B[bi], dtype)
                         ).reshape(M, nchunk_max, 8 * N)
-                        p1, mem1 = bfgsfit_minibatch_consensus(
-                            dbs[bi], cbs[bi], p_bands[bi], Y_bands[bi], BZ,
-                            rho[bi], memory=mem_bands[bi],
-                            itmax=cfg.max_lbfgs, lbfgs_m=cfg.lbfgs_m,
-                            robust_nu=robust_nu,
-                        )
+                        t_band = time.perf_counter()
+                        with tracer.span("admm.band", kind="band", band=bi,
+                                         lane=f"band{bi}", round=admm):
+                            p1, mem1 = bfgsfit_minibatch_consensus(
+                                dbs[bi], cbs[bi], p_bands[bi], Y_bands[bi],
+                                BZ, rho[bi], memory=mem_bands[bi],
+                                itmax=cfg.max_lbfgs, lbfgs_m=cfg.lbfgs_m,
+                                robust_nu=robust_nu,
+                            )
+                            if tracer.enabled:
+                                p1 = jax.block_until_ready(p1)
+                        if tracer.enabled:
+                            band_secs[bi] += time.perf_counter() - t_band
                         p_bands[bi], mem_bands[bi] = p1, mem1
                         Yhat = Y_bands[bi] + rho[bi][:, None, None] * p1
                         zacc = zacc + consensus.accumulate_z_term(
@@ -204,6 +254,7 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                             Y_bands[bi]
                             + rho[bi][:, None, None] * (p_bands[bi] - BZ1)
                         )
+                    round_span.__exit__(None, None, None)
                     if track:
                         # per-band scaled primal residuals (the same
                         # normalization the mesh driver logs,
@@ -229,6 +280,37 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                         if cfg.verbose:
                             log(f"  admm {admm}: primal "
                                 f"{sum(pres_band):.4e} dual {dres:.4e}")
+                if tracer.enabled and len(bands) > 1:
+                    # straggler gauges on the MEASURED per-band seconds
+                    # (same gauge names as the mesh driver's attributed
+                    # ones, so dashboards join across modes)
+                    from sagecal_tpu.obs.registry import get_registry
+
+                    stats = straggler_stats(band_secs)
+                    reg = get_registry()
+                    for bi, s in enumerate(band_secs):
+                        reg.gauge_set(
+                            "admm_band_seconds", s,
+                            help="measured per-band seconds of this "
+                                 "minibatch's band ADMM", band=str(bi))
+                    reg.gauge_set(
+                        "admm_straggler_ratio", stats["ratio"],
+                        help="slowest/median measured band seconds of "
+                             "the band ADMM")
+                    reg.gauge_set(
+                        "admm_band_skew", stats["skew"],
+                        help="(max-mean)/mean measured band seconds")
+                    if stats["detected"]:
+                        if elog is not None:
+                            elog.emit("straggler_detected", epoch=epoch,
+                                      minibatch=mb, band=stats["argmax"],
+                                      ratio=stats["ratio"],
+                                      skew=stats["skew"],
+                                      band_seconds=band_secs,
+                                      threshold=stats["threshold"])
+                        log(f"epoch {epoch} minibatch {mb}: straggler "
+                            f"band {stats['argmax']} "
+                            f"({stats['ratio']:.2f}x median)")
                 if pres_traj:
                     # ADMM watchdog: a band whose primal residual grows
                     # away from its trajectory minimum (or goes
@@ -259,6 +341,9 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                         abort_if_diverged(elog, verdict, reasons,
                                           epoch=epoch, minibatch=mb,
                                           app="minibatch")
+            note_activity("minibatch", name=f"e{epoch}mb{mb}",
+                          seconds=time.time() - tic)
+            mb_span.__exit__(None, None, None)
             if elog is not None:
                 elog.emit("minibatch_done", epoch=epoch, minibatch=mb,
                           t0=t0, t1=t1, seconds=time.time() - tic)
@@ -308,6 +393,9 @@ def _run_minibatch(cfg: RunConfig, log, audit):
         emit_contract_events(elog)
         elog.emit("run_done", n_bands=len(bands))
         elog.close()
+        unregister_event_log(elog)
+    run_span.__exit__(None, None, None)
+    close_tracer()
 
     # write per-band solutions
     with open(cfg.out_solutions, "w") as fh:
@@ -319,4 +407,7 @@ def _run_minibatch(cfg: RunConfig, log, audit):
             )
             solio.append_solutions(fh, jsol)
     ds.close()
+    # success path only: leaves the final "closed" heartbeat; a crash
+    # keeps the recorder alive for the excepthook's dump
+    close_flight_recorder()
     return results

@@ -17,6 +17,12 @@ Four pieces (SURVEY section 5 "observability"):
   runtime contracts: checkify NaN/div/index checks on every
   ``instrumented_jit`` entry, surfaced as ``contract_violation``
   events (CLI exit 4).
+- :mod:`sagecal_tpu.obs.trace` — hierarchical execution spans
+  (``SAGECAL_TRACE=1``): span-tree JSONL + Chrome-trace export, ADMM
+  per-band straggler attribution.
+- :mod:`sagecal_tpu.obs.flight` — in-process flight recorder
+  (``SAGECAL_FLIGHT=1``): bounded activity ring, heartbeat file, hang
+  watchdog, and crash handlers dumping all-thread stacks.
 - :mod:`sagecal_tpu.obs.diag` — the ``sagecal-tpu diag`` CLI.
 
 This package root imports neither jax nor numpy (obs.perf defers its
@@ -38,7 +44,30 @@ from sagecal_tpu.obs.events import (  # noqa: F401
     RunManifest,
     default_event_log,
     read_events,
+    read_events_merged,
     validate_manifest,
+)
+from sagecal_tpu.obs.trace import (  # noqa: F401
+    NullTracer,
+    Tracer,
+    band_attribution,
+    close_tracer,
+    configure_tracer,
+    get_tracer,
+    read_spans,
+    set_trace,
+    straggler_stats,
+    trace_enabled,
+    write_chrome_trace,
+)
+from sagecal_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    close_flight_recorder,
+    flight_enabled,
+    get_flight_recorder,
+    install_crash_handlers,
+    note_activity,
+    set_flight,
 )
 from sagecal_tpu.obs.contracts import (  # noqa: F401
     ContractViolation,
@@ -91,7 +120,26 @@ __all__ = [
     "RunManifest",
     "default_event_log",
     "read_events",
+    "read_events_merged",
     "validate_manifest",
+    "NullTracer",
+    "Tracer",
+    "band_attribution",
+    "close_flight_recorder",
+    "close_tracer",
+    "configure_tracer",
+    "get_tracer",
+    "read_spans",
+    "set_trace",
+    "straggler_stats",
+    "trace_enabled",
+    "write_chrome_trace",
+    "FlightRecorder",
+    "flight_enabled",
+    "get_flight_recorder",
+    "install_crash_handlers",
+    "note_activity",
+    "set_flight",
     "ContractViolation",
     "checkify_enabled",
     "drain_contract_events",

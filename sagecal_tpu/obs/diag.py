@@ -39,6 +39,17 @@ Subcommands:
   over the given paths (default: the installed ``sagecal_tpu``).
   Exit 1 on new (non-baselined) findings.
 
+- ``trace FILE [--chrome OUT] [--straggler-ratio R]`` — span-tree
+  report from a ``SAGECAL_TRACE=1`` run's span JSONL: tree, per-name
+  attribution, critical path, and the per-band straggler table;
+  ``--chrome`` re-exports a Perfetto-loadable ``trace.json``.  Exit 1
+  when the file holds no spans.
+
+- ``flight FILE [--ring-tail N]`` — render a flight-recorder dump
+  (``flight_dump.json``): dump reason, exception, device state,
+  all-thread stacks, and the activity-ring tail.  Exit 1 when the file
+  is missing or not a dump.
+
 Runs standalone (``python -m sagecal_tpu.obs.diag ...``) or via the
 ``diag`` subcommand of the main CLI (:mod:`sagecal_tpu.apps.cli`).
 """
@@ -53,6 +64,7 @@ from typing import List, Optional
 from sagecal_tpu.obs.events import (
     RunManifest,
     read_events,
+    read_events_merged,
     validate_manifest,
 )
 from sagecal_tpu.obs.perf import (
@@ -120,7 +132,9 @@ def _finite(xs) -> List[float]:
 
 
 def _cmd_events(args) -> int:
-    evs = read_events(args.file)
+    # merged read: picks up per-process suffixed companions
+    # (SAGECAL_EVENT_LOG_PER_PROCESS=1 runs) alongside the base log
+    evs = read_events_merged(args.file)
     if not evs:
         print(f"{args.file}: no events", file=sys.stderr)
         return 1
@@ -320,6 +334,44 @@ def _cmd_quality(args) -> int:
     return 0
 
 
+def _cmd_trace(args) -> int:
+    from sagecal_tpu.obs.trace import (
+        format_trace_report,
+        read_spans,
+        write_chrome_trace,
+    )
+
+    try:
+        spans = read_spans(args.file)
+    except OSError as e:
+        print(f"{args.file}: {e}", file=sys.stderr)
+        return 1
+    if not spans:
+        print(f"{args.file}: no spans (was the run SAGECAL_TRACE=1?)",
+              file=sys.stderr)
+        return 1
+    print(format_trace_report(spans, ratio_thresh=args.straggler_ratio))
+    if args.chrome:
+        p = write_chrome_trace(spans, args.chrome)
+        print(f"chrome trace -> {p}")
+    return 0
+
+
+def _cmd_flight(args) -> int:
+    from sagecal_tpu.obs.flight import format_dump, read_dump
+
+    try:
+        doc = read_dump(args.file)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"{args.file}: {e}", file=sys.stderr)
+        return 1
+    if not isinstance(doc, dict) or "reason" not in doc:
+        print(f"{args.file}: not a flight-recorder dump", file=sys.stderr)
+        return 1
+    print(format_dump(doc, ring_tail=args.ring_tail))
+    return 0
+
+
 def _cmd_lint(args) -> int:
     # the jaxlint package is import-light by design (stdlib ast only):
     # deferring keeps `diag manifest` usable before backend selection
@@ -389,6 +441,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="exit non-zero on degradation too, not just "
                          "divergence")
     qp.set_defaults(fn=_cmd_quality)
+
+    tp = sub.add_parser(
+        "trace",
+        help="span-tree report + straggler table from a span JSONL",
+    )
+    tp.add_argument("file", help="span JSONL (SAGECAL_TRACE_LOG)")
+    tp.add_argument("--chrome", default=None, metavar="OUT",
+                    help="also write a Perfetto-loadable trace.json here")
+    tp.add_argument("--straggler-ratio", type=float, default=None,
+                    help="slowest/median detection threshold (default "
+                         "SAGECAL_STRAGGLER_RATIO or 1.5)")
+    tp.set_defaults(fn=_cmd_trace)
+
+    dp = sub.add_parser(
+        "flight",
+        help="render a flight-recorder dump (hang/crash forensics)",
+    )
+    dp.add_argument("file", help="flight_dump.json from a stall or crash")
+    dp.add_argument("--ring-tail", type=int, default=20,
+                    help="activity-ring entries to show (default 20)")
+    dp.set_defaults(fn=_cmd_flight)
 
     lp = sub.add_parser(
         "lint",

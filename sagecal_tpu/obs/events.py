@@ -141,15 +141,20 @@ class EventLog:
     """Append-only JSONL event sink.
 
     Each :meth:`emit` writes one line ``{"ts": ..., "run_id": ...,
-    "type": <type>, ...fields}`` and flushes, so a crashed run keeps
-    every event up to the crash.  Usable as a context manager."""
+    "type": <type>, ...fields}`` as a SINGLE ``os.write`` on an
+    ``O_APPEND`` fd — POSIX appends of one buffer never interleave, so
+    concurrent writers (multi-process distributed / multihost runs)
+    sharing one file cannot corrupt each other's lines.  There is no
+    userspace buffering, so a crashed run keeps every event up to the
+    crash.  Usable as a context manager."""
 
     def __init__(self, path: str, run_id: Optional[str] = None,
                  manifest: Optional[RunManifest] = None):
         self.path = path
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
-        self._f = open(path, "a", encoding="utf-8")
+        self._fd: Optional[int] = os.open(
+            path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
         if manifest is not None and not manifest.run_id:
             manifest.run_id = uuid.uuid4().hex[:12]
         self.run_id = run_id or (
@@ -158,17 +163,24 @@ class EventLog:
         if manifest is not None:
             self.emit("run_manifest", **manifest.to_dict())
 
+    @property
+    def closed(self) -> bool:
+        return self._fd is None
+
     def emit(self, type: str, **fields) -> None:
+        fd = self._fd
+        if fd is None:
+            return
         rec = {"ts": time.time(), "run_id": self.run_id, "type": type}
         for k, v in fields.items():
             if k not in rec:
                 rec[k] = _jsonable(v)
-        self._f.write(json.dumps(rec) + "\n")
-        self._f.flush()
+        os.write(fd, (json.dumps(rec) + "\n").encode("utf-8"))
 
     def close(self) -> None:
-        if not self._f.closed:
-            self._f.close()
+        fd, self._fd = self._fd, None
+        if fd is not None:
+            os.close(fd)
 
     def __enter__(self) -> "EventLog":
         return self
@@ -198,14 +210,53 @@ def iter_events(path: str) -> Iterator[dict]:
         yield e
 
 
+def expand_event_paths(path: str) -> List[str]:
+    """Resolve an event-log argument to the set of JSONL files it names:
+    a directory expands to its ``*.jsonl`` files (plus per-process
+    ``*.jsonl.<pid>`` siblings); a file expands to itself plus any
+    ``<file>.<pid>`` companions written by
+    ``SAGECAL_EVENT_LOG_PER_PROCESS=1`` runs."""
+    import glob as _glob
+
+    if os.path.isdir(path):
+        out = sorted(_glob.glob(os.path.join(path, "*.jsonl")))
+        out += sorted(p for p in _glob.glob(os.path.join(path, "*.jsonl.*"))
+                      if p.rsplit(".", 1)[-1].isdigit())
+        return out
+    out = [path] if os.path.exists(path) else []
+    out += sorted(p for p in _glob.glob(path + ".*")
+                  if p.rsplit(".", 1)[-1].isdigit())
+    return out
+
+
+def read_events_merged(path: str) -> List[dict]:
+    """Read + merge events from every file :func:`expand_event_paths`
+    resolves, in stable timestamp order (the ``diag``-side merge for
+    per-process suffixed logs)."""
+    events: List[dict] = []
+    for p in expand_event_paths(path):
+        events.extend(read_events(p))
+    events.sort(key=lambda e: float(e.get("ts", 0.0)))
+    return events
+
+
 def default_event_log(manifest: Optional[RunManifest] = None,
                       path: Optional[str] = None) -> Optional[EventLog]:
     """The app-side entry: an :class:`EventLog` at ``SAGECAL_EVENT_LOG``
     (or ``./sagecal_events.jsonl``) when telemetry is enabled, else
-    None — callers guard every emit with ``if log is not None``."""
-    from sagecal_tpu.obs.registry import telemetry_enabled
+    None — callers guard every emit with ``if log is not None``.
+
+    ``SAGECAL_EVENT_LOG_PER_PROCESS=1`` suffixes the path with the pid
+    (one file per writer; ``diag events`` merges the companions) for
+    multihost launchers that cannot share an O_APPEND fd safely, e.g.
+    on network filesystems where append atomicity is not guaranteed."""
+    from sagecal_tpu.obs.registry import _TRUTHY, telemetry_enabled
 
     if not telemetry_enabled():
         return None
     path = path or os.environ.get("SAGECAL_EVENT_LOG") or "sagecal_events.jsonl"
+    per_proc = os.environ.get(
+        "SAGECAL_EVENT_LOG_PER_PROCESS", "").strip().lower() in _TRUTHY
+    if per_proc:
+        path = f"{path}.{os.getpid()}"
     return EventLog(path, manifest=manifest)

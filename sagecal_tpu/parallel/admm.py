@@ -174,3 +174,23 @@ def admm_dual_update(Y, p, BZ, rho):
     """Y <- Y + rho (J - BZ) (sagecal_slave.cpp:831): the scaled dual
     ascent step.  Shapes (M, nchunk_max, 8N); rho (M,)."""
     return Y + rho[:, None, None] * (p - BZ)
+
+
+def round_work_weights(nadmm: int, nslots: int, plain_emiter: int = 2,
+                       max_emiter: int = 1):
+    """Static per-ADMM-round work model (host-side, plain floats).
+
+    The mesh ADMM runs its whole nadmm loop as one jitted program, so
+    per-round host timing does not exist; this models each round's
+    x-step solver work for wall-clock attribution (obs/trace.py):
+    round 0 plain-solves ALL ``nslots`` local sub-band slots with
+    ``plain_emiter`` EM passes plus the manifold alignment, rounds >= 1
+    solve one active slot with ``max_emiter`` passes (the
+    Sbegin/Scurrent/Send rotation — see parallel/mesh.py).  Returns
+    ``nadmm`` positive weights proportional to modeled solver work;
+    the z-step psum is negligible next to the x-steps (PAPERS.md,
+    "Unwrapping ADMM")."""
+    if nadmm <= 0:
+        return []
+    w0 = float(max(nslots, 1) * max(plain_emiter, 1))
+    return [w0] + [float(max(max_emiter, 1))] * (nadmm - 1)

@@ -197,6 +197,29 @@ def consensus_health(
     return ratio, trend, diverged
 
 
+def band_imbalance(band_seconds, eps: float = 1e-30):
+    """Per-band work-imbalance gauges: ``(ratio, skew, argmax)``.
+
+    ``band_seconds``: (Nf,) per-band wall-clock (real host timings in
+    minibatch consensus mode, or :func:`sagecal_tpu.obs.trace.
+    band_attribution` shares of the mesh ADMM window).  Returns the
+    slowest/median ratio (the straggler gauge — the mesh z-step psum
+    runs at the pace of the slowest band, so ratio≈1 means the SPMD
+    collective wastes nothing), the relative skew ``(max-mean)/mean``,
+    and the index of the slowest band.
+
+    Pure array math (numpy or jax inputs) like :func:`consensus_health`,
+    so the host-side straggler detector (obs/trace.py) and any on-device
+    caller share one definition.
+    """
+    t = jnp.asarray(band_seconds)
+    med = jnp.median(t)
+    mean = jnp.mean(t)
+    ratio = jnp.max(t) / jnp.maximum(med, eps)
+    skew = (jnp.max(t) - mean) / jnp.maximum(mean, eps)
+    return ratio, skew, jnp.argmax(t)
+
+
 def admm_primal_residual(J_flat, BZ_flat):
     """Per-real-parameter primal residual ||J - BZ||/sqrt(size): how far
     one band's local solution sits from its consensus target (the

@@ -459,7 +459,23 @@ def make_admm_mesh_fn(
             Zspat=Zspat, spat_res=sres, Zspat_diff=Zdiff, **extra,
         )
 
-    return fn
+    def traced_fn(data_stack, cdata_stack, p0, rho, B):
+        # host-side dispatch span AROUND the jitted program (never
+        # inside it — jaxlint JL002 territory).  Dispatch is async, so
+        # this span covers trace/compile + enqueue only; the caller owns
+        # the block_until_ready that closes the device window and the
+        # per-band attribution over it (apps/distributed.py).
+        from sagecal_tpu.obs.trace import get_tracer
+
+        tr = get_tracer()
+        if not tr.enabled:
+            return fn(data_stack, cdata_stack, p0, rho, B)
+        with tr.span("mesh.admm.dispatch", kind="collective",
+                     nf=int(p0.shape[0]), ndev=ndev, nadmm=nadmm,
+                     async_dispatch=True):
+            return fn(data_stack, cdata_stack, p0, rho, B)
+
+    return traced_fn
 
 
 def stack_for_mesh(items):

@@ -222,4 +222,16 @@ def sharded_joint_fit(
         lbfgs_m=lbfgs_m, robust_nu=robust_nu,
         collect_quality=collect_quality,
     )
-    return fn(data, cdata, p0)
+    from sagecal_tpu.obs.trace import get_tracer
+
+    tr = get_tracer()
+    if not tr.enabled:
+        return fn(data, cdata, p0)
+    # host-side collective-section span around the dispatch (never
+    # inside the jitted program).  Unlike the mesh ADMM there is no
+    # prepare/solve pipeline to overlap here, so blocking inside the
+    # span is safe and makes it cover real device wall-time.
+    with tr.span("sharded_joint_fit", kind="collective",
+                 ndev=int(mesh.devices.size),
+                 rows=int(data.vis.shape[-1])):
+        return jax.block_until_ready(fn(data, cdata, p0))

@@ -34,6 +34,7 @@ from typing import Dict, Iterator, Optional
 import jax
 
 from sagecal_tpu.obs.registry import get_registry, telemetry_enabled
+from sagecal_tpu.obs.trace import get_tracer
 
 _TRACE_DIR_ENV = "SAGECAL_PROFILE_DIR"
 _active_trace: Optional[str] = None
@@ -89,8 +90,12 @@ class PhaseTimer:
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
         t0 = time.perf_counter()
-        with jax.profiler.TraceAnnotation(name):
-            yield
+        # host-side tracer span (SAGECAL_TRACE=1): the NullTracer hands
+        # back a shared no-op CM, so the disabled path stays allocation-
+        # free; span exits also feed the flight recorder's stall clock
+        with get_tracer().span(name, kind="phase"):
+            with jax.profiler.TraceAnnotation(name):
+                yield
         dt = time.perf_counter() - t0
         self.totals[name] += dt
         self.counts[name] += 1

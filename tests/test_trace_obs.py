@@ -1,0 +1,659 @@
+"""Execution tracing + flight recorder (obs/trace.py, obs/flight.py):
+span-tree integrity, Chrome-trace export, straggler attribution math,
+hang-watchdog stall dumps, crash handlers, multi-process event-log
+appends, the diag trace/flight CLIs, and the end-to-end distributed
+run with SAGECAL_TRACE=1 (band attribution reconciles with the measured
+ADMM window; tracing off leaves the solve bit-identical)."""
+
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import sagecal_tpu
+from sagecal_tpu.obs import flight as flightmod
+from sagecal_tpu.obs import trace as tracemod
+from sagecal_tpu.obs.diag import main as diag_main
+from sagecal_tpu.obs.events import (
+    EventLog,
+    default_event_log,
+    expand_event_paths,
+    read_events,
+    read_events_merged,
+)
+from sagecal_tpu.obs.flight import FlightRecorder, read_dump
+from sagecal_tpu.obs.trace import (
+    Tracer,
+    aggregate_by_name,
+    band_attribution,
+    band_seconds_from_spans,
+    build_span_tree,
+    critical_path,
+    format_straggler_table,
+    read_spans,
+    straggler_stats,
+    to_chrome_trace,
+)
+
+pytestmark = pytest.mark.trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(sagecal_tpu.__file__)))
+
+
+def _reset_obs_state():
+    tracemod.close_tracer()
+    tracemod.set_trace(None)
+    flightmod.reset_flight_recorder()
+    flightmod.set_flight(None)
+    flightmod.uninstall_crash_handlers()
+    flightmod._EVENT_LOGS.clear()
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Tracer / flight recorder / crash handlers are process-global;
+    every test starts and ends from a clean slate."""
+    _reset_obs_state()
+    yield
+    _reset_obs_state()
+
+
+# ---------------------------------------------------------------------------
+# span trees
+
+
+class TestSpanTree:
+    def test_nested_spans_form_tree(self, tmp_path):
+        p = str(tmp_path / "spans.jsonl")
+        tr = Tracer(p, trace_id="rid123")
+        with tr.span("run", kind="run"):
+            with tr.span("tile", tile=0):
+                with tr.span("band", band=0):
+                    pass
+                with tr.span("band", band=1):
+                    pass
+        tr.close()
+        spans = read_spans(p)
+        assert len(spans) == 4
+        assert all(s["trace_id"] == "rid123" for s in spans)
+        assert all(s["dur"] >= 0.0 for s in spans)
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        run, = by_name["run"]
+        tile, = by_name["tile"]
+        assert run["parent_id"] is None
+        assert tile["parent_id"] == run["span_id"]
+        for b in by_name["band"]:
+            assert b["parent_id"] == tile["span_id"]
+        roots, children = build_span_tree(spans)
+        assert [r["name"] for r in roots] == ["run"]
+        assert len(children[tile["span_id"]]) == 2
+        # real parents cover their children
+        assert run["dur"] >= tile["dur"] >= sum(
+            b["dur"] for b in by_name["band"])
+        path = critical_path(spans)
+        assert [s["name"] for s in path][:2] == ["run", "tile"]
+        agg = aggregate_by_name(spans)
+        assert agg["band"]["count"] == 2
+
+    def test_unbalanced_exit_truncates_stack(self, tmp_path):
+        p = str(tmp_path / "spans.jsonl")
+        tr = Tracer(p)
+        outer = tr.span("outer").__enter__()
+        tr.span("inner").__enter__()  # never exited
+        outer.__exit__(None, None, None)  # must drop inner from the stack
+        assert tr.current_span_id() is None
+        with tr.span("next"):
+            pass
+        tr.close()
+        nxt = [s for s in read_spans(p) if s["name"] == "next"]
+        assert nxt and nxt[0]["parent_id"] is None
+
+    def test_error_exit_tags_span(self, tmp_path):
+        p = str(tmp_path / "spans.jsonl")
+        tr = Tracer(p)
+        with pytest.raises(RuntimeError):
+            with tr.span("boom"):
+                raise RuntimeError("x")
+        tr.close()
+        s, = read_spans(p)
+        assert s["attrs"]["error"] == "RuntimeError"
+
+    def test_add_span_synthetic_parenting(self, tmp_path):
+        p = str(tmp_path / "spans.jsonl")
+        tr = Tracer(p)
+        admm_id = tr.add_span("admm", 2.0, kind="admm")
+        for b, s in enumerate((1.25, 0.75)):
+            tr.add_span("admm.band", s, parent_id=admm_id, band=b,
+                        synthetic=True)
+        tr.close()
+        spans = read_spans(p)
+        bands = [s for s in spans if s["name"] == "admm.band"]
+        assert all(s["parent_id"] == admm_id for s in bands)
+        assert band_seconds_from_spans(spans) == {0: 1.25, 1: 0.75}
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export
+
+
+class TestChromeTrace:
+    def test_roundtrip_loadable(self, tmp_path):
+        p = str(tmp_path / "spans.jsonl")
+        tr = Tracer(p, trace_id="rid")
+        with tr.span("run"):
+            with tr.span("band", band=3, lane="band3"):
+                pass
+        tr.close()  # writes the Chrome trace next to the JSONL
+        chrome = tracemod.default_chrome_path(p)
+        assert os.path.exists(chrome)
+        with open(chrome) as f:
+            doc = json.load(f)
+        evs = doc["traceEvents"]
+        x = [e for e in evs if e["ph"] == "X"]
+        meta = [e for e in evs if e["ph"] == "M"]
+        assert len(x) == 2
+        assert all(e["dur"] >= 0.0 and e["ts"] >= 0.0 for e in x)
+        assert any(e["name"] == "process_name" for e in meta)
+        # lane attr becomes a named track
+        assert any(e["name"] == "thread_name"
+                   and e["args"]["name"] == "band3" for e in meta)
+        # span/parent ids survive in args so trees reconstruct in the UI
+        band = [e for e in x if e["name"] == "band"][0]
+        assert band["args"]["parent_id"]
+        assert band["args"]["trace_id"] == "rid"
+
+    def test_empty_input(self):
+        assert to_chrome_trace([]) == {"traceEvents": [],
+                                       "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# straggler attribution math
+
+
+class TestStragglerAttribution:
+    def test_band_attribution_exact_sum(self):
+        out = band_attribution(7.3, [3.0, 1.0, 0.0, 2.0])
+        assert len(out) == 4
+        # last band absorbs the float residue: re-summation reconciles
+        # with the parent to ulp precision
+        assert sum(out) == pytest.approx(7.3, rel=1e-12)
+        assert out[2] == 0.0  # zero-weight padding band gets nothing
+        assert out[0] == pytest.approx(7.3 * 3.0 / 6.0)
+
+    def test_band_attribution_uniform_fallback(self):
+        out = band_attribution(2.0, [0.0, 0.0, -1.0, 0.0])
+        assert sum(out) == pytest.approx(2.0, rel=1e-12)
+        assert out[:3] == [0.5, 0.5, 0.5]
+        assert band_attribution(1.0, []) == []
+
+    def test_straggler_stats_detection(self):
+        stats = straggler_stats([1.0, 1.0, 1.0, 10.0], ratio_thresh=1.5)
+        assert stats["detected"] and stats["argmax"] == 3
+        assert stats["ratio"] == pytest.approx(10.0)
+        assert stats["median"] == pytest.approx(1.0)
+        balanced = straggler_stats([1.0, 1.01, 0.99], ratio_thresh=1.5)
+        assert not balanced["detected"]
+        # one band is never a straggler relative to itself
+        assert not straggler_stats([5.0], ratio_thresh=1.5)["detected"]
+        assert not straggler_stats([], ratio_thresh=1.5)["detected"]
+
+    def test_threshold_env(self, monkeypatch):
+        monkeypatch.setenv("SAGECAL_STRAGGLER_RATIO", "4.0")
+        assert tracemod.straggler_ratio_threshold() == 4.0
+        # ratio is slowest/median, so the raised threshold needs a
+        # 3-band set to trip
+        assert not straggler_stats([1.0, 1.0, 3.0])["detected"]
+        assert straggler_stats([1.0, 1.0, 9.0])["detected"]
+
+    def test_format_straggler_table(self):
+        txt = format_straggler_table({0: 1.0, 1: 1.0, 2: 9.0},
+                                     ratio_thresh=1.5)
+        assert "STRAGGLER DETECTED" in txt
+        assert "<-- straggler" in txt
+        assert "balanced" in format_straggler_table(
+            {0: 1.0, 1: 1.0}, ratio_thresh=1.5)
+        assert "no per-band spans" in format_straggler_table({})
+
+
+# ---------------------------------------------------------------------------
+# disabled path: zero-cost, no files
+
+
+class TestDisabledPath:
+    def test_null_tracer_shared_and_silent(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        tracemod.set_trace(False)
+        tr = tracemod.get_tracer()
+        assert tr is tracemod._NULL and not tr.enabled
+        # span() hands back ONE shared no-op CM: allocation-free off-path
+        assert tr.span("a", x=1) is tr.span("b")
+        with tr.span("a"):
+            pass
+        assert tr.add_span("a", 1.0) is None
+        assert tracemod.configure_tracer(run_id="r") is None
+        assert list(tmp_path.iterdir()) == []  # nothing written
+
+    def test_flight_disabled_no_recorder(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        flightmod.set_flight(False)
+        assert flightmod.get_flight_recorder() is None
+        flightmod.note_activity("span", name="x")  # no-op without recorder
+        assert list(tmp_path.iterdir()) == []
+
+    def test_env_enables(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("SAGECAL_TRACE", "1")
+        monkeypatch.setenv("SAGECAL_TRACE_LOG", str(tmp_path / "t.jsonl"))
+        assert tracemod.trace_enabled()
+        tr = tracemod.get_tracer()  # auto-configures from env
+        assert isinstance(tr, Tracer)
+        with tr.span("x"):
+            pass
+        tracemod.close_tracer()
+        assert len(read_spans(str(tmp_path / "t.jsonl"))) == 1
+        assert os.path.exists(str(tmp_path / "t.trace.json"))
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring, heartbeat, watchdog
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self, tmp_path):
+        fr = FlightRecorder(heartbeat_path=str(tmp_path / "hb"),
+                            dump_path=str(tmp_path / "d.json"),
+                            ring_size=8, stall_seconds=1e6)
+        for i in range(50):
+            fr._append("tick", name=f"t{i}")
+        snap = fr.snapshot()
+        assert len(snap) == 8
+        assert snap[-1]["name"] == "t49"
+
+    def test_watchdog_dumps_on_stall_then_resolves(self, tmp_path):
+        hb = str(tmp_path / "hb.json")
+        dump = str(tmp_path / "flight_dump.json")
+        fr = FlightRecorder(heartbeat_path=hb, dump_path=dump,
+                            ring_size=32, stall_seconds=0.3, run_id="wd1")
+        fr.record("phase", name="warmup")
+        fr.start(poll_seconds=0.05)
+        try:
+            deadline = time.monotonic() + 15.0
+            while not os.path.exists(dump) and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert os.path.exists(dump), "watchdog never dumped on stall"
+            doc = read_dump(dump)
+            assert doc["reason"] == "stall"
+            assert doc["run_id"] == "wd1"
+            # all-thread stacks captured, incl. the main (stalled) thread
+            names = [t["name"] for t in doc["threads"]]
+            assert "MainThread" in names
+            assert all(t["stack"] for t in doc["threads"])
+            # the ring tail holds the pre-stall activity + the detection
+            kinds = [e["kind"] for e in doc["ring"]]
+            assert "phase" in kinds and "hang_detected" in kinds
+            # heartbeat file kept fresh by the watchdog during the stall
+            assert os.path.exists(hb)
+            assert json.load(open(hb))["stalled"] in (True, False)
+            # the run is NOT killed: we are still executing, and resumed
+            # activity closes the stall window
+            fr.record("phase", name="resumed")
+            kinds = [e["kind"] for e in fr.snapshot()]
+            assert "stall_resolved" in kinds
+        finally:
+            fr.stop()
+        final = json.load(open(hb))
+        assert final["closed"] is True and final["run_id"] == "wd1"
+
+    def test_heartbeat_written_on_record(self, tmp_path):
+        hb = str(tmp_path / "hb.json")
+        fr = FlightRecorder(heartbeat_path=hb,
+                            dump_path=str(tmp_path / "d.json"),
+                            stall_seconds=1e6, run_id="hb1")
+        fr.record("span", name="s")  # opportunistic beat, no watchdog yet
+        doc = json.load(open(hb))
+        assert doc["pid"] == os.getpid() and doc["run_id"] == "hb1"
+        assert doc["closed"] is False
+
+    def test_dump_is_diag_flight_readable(self, tmp_path, capsys):
+        dump = str(tmp_path / "d.json")
+        fr = FlightRecorder(heartbeat_path=str(tmp_path / "hb"),
+                            dump_path=dump, stall_seconds=1e6, run_id="dd")
+        fr.record("phase", name="p0")
+        fr.dump("manual")
+        assert diag_main(["flight", dump]) == 0
+        out = capsys.readouterr().out
+        assert "reason=manual" in out and "MainThread" in out
+        assert "ring buffer" in out
+
+
+# ---------------------------------------------------------------------------
+# crash handlers
+
+
+class TestCrashHandlers:
+    def test_excepthook_dumps_and_flushes_event_log(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SAGECAL_HEARTBEAT_FILE", str(tmp_path / "hb"))
+        monkeypatch.setenv("SAGECAL_FLIGHT_DUMP",
+                           str(tmp_path / "flight_dump.json"))
+        flightmod.set_flight(True)
+        flightmod.get_flight_recorder(run_id="crash1")
+        seen = []
+        monkeypatch.setattr(sys, "excepthook", lambda *a: seen.append(a))
+        flightmod.install_crash_handlers()
+        elp = str(tmp_path / "ev.jsonl")
+        elog = EventLog(elp, run_id="crash1")
+        flightmod.register_event_log(elog)
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            sys.excepthook(*sys.exc_info())
+        assert seen, "chained previous excepthook was not called"
+        dump = json.load(open(tmp_path / "flight_dump.json"))
+        assert dump["reason"] == "uncaught_exception"
+        assert dump["exception"]["type"] == "ValueError"
+        assert "boom" in dump["exception"]["value"]
+        evs = read_events(elp)
+        ab = [e for e in evs if e["type"] == "run_aborted"]
+        assert ab and ab[0]["reason"].startswith("uncaught_exception")
+        assert ab[0]["flight_dump"] == str(tmp_path / "flight_dump.json")
+        assert elog.closed  # flushed log is closed, later emits are no-ops
+
+    def test_install_is_idempotent_and_uninstalls(self, monkeypatch):
+        hooks = []
+        monkeypatch.setattr(sys, "excepthook", lambda *a: hooks.append(a))
+        prev = sys.excepthook
+        flightmod.install_crash_handlers()
+        flightmod.install_crash_handlers()  # second call must not re-chain
+        assert sys.excepthook is flightmod._excepthook
+        assert flightmod._PREV_EXCEPTHOOK is prev
+        flightmod.uninstall_crash_handlers()
+        assert sys.excepthook is prev
+
+    def test_sigterm_subprocess_dump_and_abort_event(self, tmp_path):
+        """A SIGTERM'd run leaves a flight dump + a run_aborted event
+        and still dies with the SIGTERM exit status (satellite 2)."""
+        elp = str(tmp_path / "ev.jsonl")
+        dump = str(tmp_path / "flight_dump.json")
+        script = tmp_path / "victim.py"
+        script.write_text(textwrap.dedent("""\
+            import os, signal
+            from sagecal_tpu.obs.events import EventLog
+            from sagecal_tpu.obs import flight as fl
+            fl.install_crash_handlers()
+            fl.get_flight_recorder(run_id="victim")
+            elog = EventLog(os.environ["ELOG"], run_id="victim")
+            fl.register_event_log(elog)
+            elog.emit("started")
+            os.kill(os.getpid(), signal.SIGTERM)
+            raise SystemExit("unreachable: SIGTERM must kill the process")
+        """))
+        env = dict(os.environ,
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""),
+                   SAGECAL_FLIGHT="1", ELOG=elp,
+                   SAGECAL_HEARTBEAT_FILE=str(tmp_path / "hb"),
+                   SAGECAL_FLIGHT_DUMP=dump)
+        r = subprocess.run([sys.executable, str(script)], env=env,
+                           capture_output=True, timeout=60)
+        assert r.returncode == -signal.SIGTERM, (r.returncode, r.stderr)
+        doc = json.load(open(dump))
+        assert doc["reason"] == "sigterm"
+        # sagecal_tpu imports jax, so the guarded device snapshot runs
+        assert "jax_imported" in doc["device_state"]
+        assert doc["threads"] and all(t["stack"] for t in doc["threads"])
+        types = [e["type"] for e in read_events(elp)]
+        assert types == ["started", "run_aborted"]
+        ab = read_events(elp)[-1]
+        assert ab["reason"] == "sigterm" and ab["flight_dump"] == dump
+
+
+# ---------------------------------------------------------------------------
+# multi-process event-log hardening (satellite 3)
+
+
+class TestEventLogMultiProcess:
+    def test_two_concurrent_writers_never_interleave_lines(self, tmp_path):
+        """Two processes hammering ONE log file: every line must stay a
+        complete JSON object (O_APPEND single-write contract)."""
+        elp = str(tmp_path / "shared.jsonl")
+        script = tmp_path / "writer.py"
+        script.write_text(textwrap.dedent("""\
+            import sys
+            from sagecal_tpu.obs.events import EventLog
+            elog = EventLog(sys.argv[1], run_id=sys.argv[2])
+            for i in range(200):
+                elog.emit("tick", i=i, pad="x" * 64)
+            elog.close()
+        """))
+        env = dict(os.environ,
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), elp, rid], env=env)
+            for rid in ("w1", "w2")]
+        for p in procs:
+            assert p.wait(timeout=120) == 0
+        raw = [ln for ln in open(elp) if ln.strip()]
+        assert len(raw) == 400
+        parsed = [json.loads(ln) for ln in raw]  # raises on any torn line
+        counts = {}
+        for e in parsed:
+            counts[e["run_id"]] = counts.get(e["run_id"], 0) + 1
+        assert counts == {"w1": 200, "w2": 200}
+        # per-writer event order survives within the shared file
+        for rid in ("w1", "w2"):
+            seq = [e["i"] for e in parsed if e["run_id"] == rid]
+            assert seq == list(range(200))
+
+    def test_per_process_suffix_and_merge(self, tmp_path, monkeypatch):
+        base = str(tmp_path / "ev.jsonl")
+        monkeypatch.setenv("SAGECAL_TELEMETRY", "1")
+        monkeypatch.setenv("SAGECAL_EVENT_LOG", base)
+        monkeypatch.setenv("SAGECAL_EVENT_LOG_PER_PROCESS", "1")
+        elog = default_event_log()
+        assert elog is not None and elog.path == f"{base}.{os.getpid()}"
+        elog.emit("tick", i=0)
+        elog.emit("tick", i=1)
+        elog.close()
+        assert not os.path.exists(base)  # only the suffixed companion
+        assert expand_event_paths(base) == [f"{base}.{os.getpid()}"]
+        merged = read_events_merged(base)
+        assert [e["i"] for e in merged] == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# diag trace / diag flight CLIs
+
+
+class TestDiagCLIs:
+    def _make_trace(self, tmp_path):
+        p = str(tmp_path / "spans.jsonl")
+        tr = Tracer(p, trace_id="rid")
+        admm_id = tr.add_span("admm", 4.0, kind="admm", tile=0)
+        for b, s in enumerate(band_attribution(4.0, [1.0, 1.0, 6.0])):
+            tr.add_span("admm.band", s, parent_id=admm_id, band=b,
+                        lane=f"band{b}", synthetic=True)
+        tr.close()
+        return p
+
+    def test_trace_report_and_chrome_export(self, tmp_path, capsys):
+        p = self._make_trace(tmp_path)
+        chrome = str(tmp_path / "out.trace.json")
+        assert diag_main(["trace", p, "--chrome", chrome]) == 0
+        out = capsys.readouterr().out
+        assert "straggler table" in out
+        assert "STRAGGLER DETECTED" in out  # band 2 is 6x the others
+        assert "critical path" in out
+        with open(chrome) as f:
+            assert json.load(f)["traceEvents"]
+
+    def test_trace_straggler_ratio_flag(self, tmp_path, capsys):
+        p = self._make_trace(tmp_path)
+        assert diag_main(["trace", p, "--straggler-ratio", "10.0"]) == 0
+        assert "balanced" in capsys.readouterr().out
+
+    def test_trace_missing_and_empty(self, tmp_path, capsys):
+        assert diag_main(["trace", str(tmp_path / "nope.jsonl")]) == 1
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text('{"type": "not_a_span"}\n')
+        assert diag_main(["trace", str(empty)]) == 1
+        assert "SAGECAL_TRACE=1" in capsys.readouterr().err
+
+    def test_flight_missing_and_invalid(self, tmp_path):
+        assert diag_main(["flight", str(tmp_path / "nope.json")]) == 1
+        bad = tmp_path / "bad.json"
+        bad.write_text("not json")
+        assert diag_main(["flight", str(bad)]) == 1
+        noreason = tmp_path / "noreason.json"
+        noreason.write_text('{"pid": 1}')
+        assert diag_main(["flight", str(noreason)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: traced distributed run + bit-identical untraced solve
+
+SKY = """P1 0 0 0.0 51 0 0.0 2.0 0 0 0 0 0 0 0 0 0 0 150e6
+P2 0 2 0.0 50 30 0.0 1.0 0 0 0 0 0 0 0 0 0 0 150e6
+"""
+CLUSTER = "1 1 P1\n2 1 P2\n"
+
+
+def _make_bands(tmp_path, Nf=4, nstations=7, ntime=2, seed=5):
+    """Nf band datasets with gains linear in frequency (same synthetic
+    observation as test_distributed)."""
+    import h5py
+    import jax.numpy as jnp
+
+    from sagecal_tpu.io.dataset import simulate_dataset
+    from sagecal_tpu.io.skymodel import load_sky
+
+    sky = tmp_path / "t.sky.txt"
+    sky.write_text(SKY)
+    (tmp_path / "t.sky.txt.cluster").write_text(CLUSTER)
+    clusters, _, _ = load_sky(str(sky), str(sky) + ".cluster",
+                              0.0, math.radians(51.0), dtype=np.float64)
+    rng = np.random.default_rng(seed)
+    M, N = 2, nstations
+    eye = np.eye(2)[None, None]
+    Z0 = eye + 0.2 * (rng.standard_normal((M, N, 2, 2))
+                      + 1j * rng.standard_normal((M, N, 2, 2)))
+    Z1 = 0.1 * (rng.standard_normal((M, N, 2, 2))
+                + 1j * rng.standard_normal((M, N, 2, 2)))
+    freqs = np.linspace(130e6, 170e6, Nf)
+    for f in range(Nf):
+        frat = (freqs[f] - 150e6) / 150e6
+        p = tmp_path / f"band{f}.h5"
+        simulate_dataset(
+            str(p), nstations=N, ntime=ntime, nchan=1, freq0=freqs[f],
+            clusters=clusters, jones=jnp.asarray(Z0 + frat * Z1),
+            noise_sigma=1e-4, seed=seed + f, dec0=math.radians(51.0))
+        with h5py.File(str(p), "r+") as fh:
+            fh.attrs["ra0"] = 0.0
+            fh.attrs["dec0"] = math.radians(51.0)
+    return sky
+
+
+def _sol_lines(path):
+    return [ln for ln in open(path) if not ln.startswith("#")]
+
+
+class TestDistributedTraceE2E:
+    def test_traced_run_attribution_and_off_path_identical(
+            self, tmp_path, monkeypatch, devices8, capsys):
+        from sagecal_tpu.apps.config import RunConfig
+        from sagecal_tpu.apps.distributed import run_distributed
+
+        sky = _make_bands(tmp_path, Nf=4)
+
+        def cfg(out):
+            return RunConfig(
+                dataset=str(tmp_path / "band*.h5"),
+                sky_model=str(sky), cluster_file=str(sky) + ".cluster",
+                out_solutions=out,
+                tilesz=2, max_emiter=1, max_iter=5, npoly=2,
+                admm_iters=3, admm_rho=10.0, solver_mode=1)
+
+        # --- baseline: tracing + flight OFF
+        tracemod.set_trace(False)
+        flightmod.set_flight(False)
+        sol_off = str(tmp_path / "zsol_off.txt")
+        traces_off = run_distributed(cfg(sol_off), log=lambda *a: None)
+
+        # --- traced run: SAGECAL_TRACE=1 + flight recorder on
+        span_file = str(tmp_path / "trace" / "run.jsonl")
+        hb = str(tmp_path / "trace" / "hb.json")
+        monkeypatch.setenv("SAGECAL_TRACE_LOG", span_file)
+        monkeypatch.setenv("SAGECAL_HEARTBEAT_FILE", hb)
+        monkeypatch.setenv("SAGECAL_FLIGHT_DUMP",
+                           str(tmp_path / "trace" / "flight_dump.json"))
+        tracemod.set_trace(True)
+        flightmod.set_flight(True)
+        sol_on = str(tmp_path / "zsol_on.txt")
+        traces_on = run_distributed(cfg(sol_on), log=lambda *a: None)
+
+        # tracing must not perturb the solve: bit-identical residual
+        # traces and solution files
+        assert len(traces_on) == len(traces_off) == 1
+        for (d_on, p_on), (d_off, p_off) in zip(traces_on, traces_off):
+            assert np.array_equal(np.asarray(d_on), np.asarray(d_off))
+            assert np.array_equal(np.asarray(p_on), np.asarray(p_off))
+        assert _sol_lines(sol_on) == _sol_lines(sol_off)
+        for b in range(4):
+            assert _sol_lines(f"{sol_on}.band{b}") == \
+                _sol_lines(f"{sol_off}.band{b}")
+
+        # span file: run > tile > admm tree, correlated on one trace id
+        spans = read_spans(span_file)
+        assert spans, "traced run wrote no spans"
+        tids = {s["trace_id"] for s in spans}
+        assert len(tids) == 1
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        run, = by_name["distributed"]
+        tile, = by_name["tile"]
+        admm, = by_name["admm"]
+        assert tile["parent_id"] == run["span_id"]
+        assert admm["parent_id"] == tile["span_id"]
+
+        # per-band synthetic children reconcile EXACTLY with the
+        # measured ADMM window (band_attribution's sum contract)
+        bands = by_name["admm.band"]
+        assert len(bands) == 4
+        assert all(b["parent_id"] == admm["span_id"]
+                   and b["attrs"]["synthetic"] for b in bands)
+        assert sum(b["dur"] for b in bands) == pytest.approx(
+            admm["dur"], rel=1e-9, abs=1e-9)
+        rounds = by_name["admm.round"]
+        assert sum(r["dur"] for r in rounds) == pytest.approx(
+            admm["dur"], rel=1e-9, abs=1e-9)
+
+        # Chrome trace written on close and loadable
+        chrome = tracemod.default_chrome_path(span_file)
+        with open(chrome) as f:
+            doc = json.load(f)
+        assert len([e for e in doc["traceEvents"] if e["ph"] == "X"]) \
+            == len(spans)
+
+        # flight recorder ran alongside: fresh heartbeat carrying the
+        # same run id the spans are correlated on, and the clean exit
+        # left it marked closed (watch-script contract)
+        hb_doc = json.load(open(hb))
+        assert hb_doc["run_id"] == spans[0]["trace_id"]
+        assert hb_doc["closed"] is True
+        assert time.time() - os.path.getmtime(hb) < 600
+
+        # diag trace renders the straggler table from the span file
+        assert diag_main(["trace", span_file]) == 0
+        out = capsys.readouterr().out
+        assert "straggler table" in out and "admm.band" in out

@@ -46,10 +46,23 @@ step kernel-full-shape 560 python kdiag.py full
 echo "=== fused bench (north-star; fused is the TPU default)"
 if probe; then
   SAGECAL_TELEMETRY=1 SAGECAL_EVENT_LOG="$MANIFEST_DIR/bench.jsonl" \
+    SAGECAL_TRACE=1 SAGECAL_TRACE_LOG="$MANIFEST_DIR/bench_trace.jsonl" \
+    SAGECAL_FLIGHT=1 SAGECAL_HEARTBEAT_FILE="$MANIFEST_DIR/.heartbeat" \
+    SAGECAL_FLIGHT_DUMP="$MANIFEST_DIR/flight_dump.json" \
     timeout 560 python bench.py | tee "$MANIFEST_DIR/bench_new.json"
   # the bench must have logged a valid manifest + its result event
   timeout 60 python -m sagecal_tpu.obs.diag validate \
     "$MANIFEST_DIR/bench.jsonl" || { echo "bench event log invalid"; exit 1; }
+  # span file must load and render (bench span + any collective spans)
+  timeout 60 python -m sagecal_tpu.obs.diag trace \
+    "$MANIFEST_DIR/bench_trace.jsonl" \
+    || { echo "diag trace found no spans"; exit 1; }
+  # the flight recorder must have heartbeat during the TPU step: a
+  # missing/ancient heartbeat means the watchdog thread never ran
+  HB_AGE=$(( $(date +%s) - $(stat -c %Y "$MANIFEST_DIR/.heartbeat" 2>/dev/null || echo 0) ))
+  if [ "$HB_AGE" -gt 600 ]; then
+    echo "heartbeat missing/stale (age ${HB_AGE}s)"; exit 1
+  fi
   timeout 60 python -m sagecal_tpu.obs.diag events "$MANIFEST_DIR/bench.jsonl"
   # perf attribution must be non-empty: an empty table means the bench
   # silently lost its instrumentation
@@ -69,9 +82,9 @@ if probe; then
 fi
 echo "=== bf16-coherency fused bench"
 if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
-echo "=== telemetry+quality test pass (CPU, marker-driven)"
+echo "=== telemetry+quality+trace test pass (CPU, marker-driven)"
 JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 900 \
-  python -m pytest tests/ -q -m "telemetry or quality" \
+  python -m pytest tests/ -q -m "telemetry or quality or trace" \
   -p no:cacheprovider | tail -3
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then echo "telemetry test pass FAILED rc=$rc"; exit 1; fi

@@ -26,7 +26,14 @@ if [ "$HEALTHY" != 1 ]; then
   exit 1
 fi
 
-# (a) bank the plain bench (persistent compile cache speeds retries)
+# (a) bank the plain bench (persistent compile cache speeds retries).
+# Link the freshest flight-recorder dump (if a previous run hung or
+# crashed) into the log — bench.py attaches the same path to its
+# tpu_recovery_attempted event, so forensics start from either artifact.
+DUMP=$(ls -1t /root/repo/flight_dump*.json 2>/dev/null | head -1)
+if [ -n "${DUMP:-}" ]; then
+  echo "latest flight dump: $DUMP" >> "$LOG"
+fi
 export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
 echo "=== banking plain TPU bench at $(date)" >> "$LOG"
 timeout 900 python bench.py > /root/repo/bench_tpu_watch.json 2>/root/repo/bench_tpu_watch.err

@@ -1,5 +1,17 @@
 #!/bin/bash
 # Probe the tunnel every ~3 min; on recovery, detach tpu_kernel_check.sh.
+# Before declaring a wedge, consult the in-process flight-recorder
+# heartbeat (obs/flight.py writes it every watchdog tick): a calibration
+# run that is merely slow keeps its heartbeat fresh even when the probe
+# times out behind it, and must NOT be treated as hung.
+HB="${SAGECAL_HEARTBEAT_FILE:-/root/repo/.sagecal_heartbeat}"
+STALE="${SAGECAL_HEARTBEAT_STALE:-600}"
+hb_fresh() {
+  [ -f "$HB" ] || return 1
+  local age
+  age=$(( $(date +%s) - $(stat -c %Y "$HB" 2>/dev/null || echo 0) ))
+  [ "$age" -lt "$STALE" ]
+}
 for i in $(seq 1 3); do
   if timeout 75 python -c "import jax; print(jax.devices())" 2>/dev/null | grep -q TPU; then
     echo "TUNNEL HEALTHY at $(date)" >> /root/repo/tpu_watch.log
@@ -8,6 +20,10 @@ for i in $(seq 1 3); do
       nohup /root/repo/tpu_kernel_check.sh > /root/repo/tpu_check.out 2>&1 &
       echo "check launched" >> /root/repo/tpu_watch.log
     fi
+    exit 0
+  fi
+  if hb_fresh; then
+    echo "probe failed but calibration heartbeat fresh ($HB) at $(date) - alive, not wedged" >> /root/repo/tpu_watch.log
     exit 0
   fi
   echo "wedged at $(date)" >> /root/repo/tpu_watch.log

@@ -1,8 +1,23 @@
 #!/bin/bash
 # Restarts tpu_recover.sh if it hits its 11h give-up deadline while the
 # tunnel is still wedged (round 5 runs past the round-4 watcher's
-# deadline).  Exits quietly if the watcher ended because it banked.
+# deadline).  Exits quietly if the watcher ended because it banked, or
+# if a live calibration's flight-recorder heartbeat is fresh — a restart
+# (and the recovery sequence's bench) must never preempt a run that is
+# demonstrably making progress.
+HB="${SAGECAL_HEARTBEAT_FILE:-/root/repo/.sagecal_heartbeat}"
+STALE="${SAGECAL_HEARTBEAT_STALE:-600}"
+hb_fresh() {
+  [ -f "$HB" ] || return 1
+  local age
+  age=$(( $(date +%s) - $(stat -c %Y "$HB" 2>/dev/null || echo 0) ))
+  [ "$age" -lt "$STALE" ]
+}
 while ps -p "$1" >/dev/null 2>&1; do sleep 120; done
+if hb_fresh; then
+  echo "supervisor: heartbeat fresh ($HB), not restarting at $(date)" >> /root/repo/tpu_watch.log
+  exit 0
+fi
 if tail -3 /root/repo/tpu_watch.log | grep -q "GAVE UP"; then
   echo "supervisor: restarting watcher at $(date)" >> /root/repo/tpu_watch.log
   exec /root/repo/tpu_recover.sh
