@@ -165,6 +165,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="terminate (with a structured run_aborted event) "
                     "when the quality watchdog reports a diverged solve; "
                     "default is report-only")
+    # elastic execution (sagecal_tpu/elastic/)
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from the newest valid checkpoint in the "
+                    "checkpoint directory (refused, exit 5, when the run "
+                    "configuration or data fingerprint mismatches)")
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help=">0 writes an atomic solver-state checkpoint "
+                    "every this many tile (or minibatch) boundaries; "
+                    "--resume implies 1 when unset")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="checkpoint directory (default: "
+                    "<solutions>.ckpt)")
     return ap
 
 
@@ -214,6 +226,9 @@ def config_from_args(args) -> RunConfig:
         influence=args.influence,
         use_fused_predict=args.fused,
         abort_on_divergence=args.abort_on_divergence,
+        resume=args.resume,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_dir=args.checkpoint_dir,
     )
 
 
@@ -243,6 +258,7 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     _warn_dropped_fused(args)
     cfg = config_from_args(args)
+    from sagecal_tpu.elastic import ResumeRefused
     from sagecal_tpu.obs.contracts import ContractViolation
     from sagecal_tpu.obs.quality import DivergenceAbort
 
@@ -259,6 +275,13 @@ def main(argv=None):
         # JSONL log (apps drain it before re-raising)
         print(f"sagecal-tpu: {e}", file=sys.stderr)
         return 4
+    except ResumeRefused as e:
+        # --resume against a checkpoint whose config/data fingerprint
+        # mismatches (or whose solution files are inconsistent): refuse
+        # rather than silently corrupt; the resume_refused event is
+        # already in the JSONL log
+        print(f"sagecal-tpu: {e}", file=sys.stderr)
+        return 5
 
 
 def _dispatch(args, cfg) -> int:

@@ -74,6 +74,15 @@ class RunConfig:
     # influence-function diagnostics in place of residuals (-i,
     # diagnostics.c / fullbatch_mode.cpp:526-534)
     influence: bool = False
+    # elastic execution (sagecal_tpu/elastic/): checkpoint_every > 0
+    # writes an atomic solver-state checkpoint every that many tile
+    # boundaries; resume restarts from the newest valid checkpoint
+    # (deriving the effective skip count, truncating any torn trailing
+    # solution interval, warm-starting the gains).  checkpoint_dir
+    # defaults to "<out_solutions>.ckpt".
+    resume: bool = False
+    checkpoint_every: int = 0
+    checkpoint_dir: Optional[str] = None
     # precision
     use_f64: bool = True
     verbose: bool = False  # -V

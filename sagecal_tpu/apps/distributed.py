@@ -57,12 +57,17 @@ def write_global_z_header(fh, freq0_hz, npoly, nstations, nclusters, neff):
     fh.write(f"{freq0_hz * 1e-6:.6f} {npoly} {nstations} {nclusters} {neff}\n")
 
 
-def append_global_z(fh, Z, nstations, npoly, nchunk_max):
+def append_global_z(fh, Z, nstations, npoly, nchunk_max, flush: bool = True):
     """One timeslot's Z rows (sagecal_master.cpp:1165-1175): row p of
     N*8*Npoly values, effective-cluster columns in REVERSE order.
 
     Z: (M, Npoly, nchunk_max*8N) real.
-    """
+
+    Crash-safety contract mirrors :func:`sagecal_tpu.io.solutions.
+    append_solutions`: the whole timeslot is one buffered write + flush,
+    so a kill between timeslots never leaves a torn interval —
+    :func:`sagecal_tpu.io.solutions.validate_global_z` truncates the
+    rare mid-write tear on resume."""
     M = Z.shape[0]
     n8 = 8 * nstations
     # effective cluster (m, c) -> (Npoly*8N,) with p = poly*8N + i
@@ -73,9 +78,13 @@ def append_global_z(fh, Z, nstations, npoly, nchunk_max):
     ]
     cols = cols[::-1]  # reverse effective-cluster ordering
     rows = npoly * n8
-    for p in range(rows):
-        vals = " ".join(f"{col[p]:e}" for col in cols)
-        fh.write(f"{p} {vals}\n")
+    buf = "".join(
+        f"{p} " + " ".join(f"{col[p]:e}" for col in cols) + "\n"
+        for p in range(rows)
+    )
+    fh.write(buf)
+    if flush:
+        fh.flush()
 
 
 def _check_band_consistency(metas, log):
@@ -379,19 +388,84 @@ def _run_distributed_inner(
     configure_tracer(run_id=manifest.run_id)
     tracer = get_tracer()
 
+    # elastic execution (sagecal_tpu/elastic/): per-tile checkpoints of
+    # the full cross-tile carry (p_bands warm start, diffuse Zspat
+    # carry, residual traces) make a SIGTERM'd run resumable bit-exactly
+    # — the mesh ADMM has no RNG, so the carry IS the whole state
+    ckmgr = None
+    resume_state = None
+    resume_done = 0
+    if cfg.resume or cfg.checkpoint_every > 0:
+        import os as _os
+
+        from sagecal_tpu.elastic import (
+            CheckpointManager,
+            ResumeRefused,
+            config_fingerprint,
+        )
+
+        fingerprint = config_fingerprint(
+            app="distributed",
+            datasets=[_os.path.abspath(p) for p in datasets],
+            sky_model=_os.path.abspath(cfg.sky_model),
+            cluster_file=_os.path.abspath(cfg.cluster_file),
+            nstations=N, ntime=ntime, nbands=Nf,
+            freqs=[float(f) for f in freqs],
+            nadmm=nadmm, tilesz=cfg.tilesz, solver_mode=cfg.solver_mode,
+            max_emiter=cfg.max_emiter, max_iter=cfg.max_iter,
+            npoly=cfg.npoly, poly_type=cfg.poly_type,
+            admm_rho=cfg.admm_rho, use_f64=cfg.use_f64,
+            in_column=cfg.in_column, skip_tiles=cfg.skip_tiles,
+            max_tiles=cfg.max_tiles, spatial_n0=spatial_n0,
+            adaptive_rho=adaptive_rho,
+        )
+        ckmgr = CheckpointManager(
+            cfg.checkpoint_dir or f"{cfg.out_solutions}.ckpt",
+            fingerprint, "distributed",
+            every=max(cfg.checkpoint_every, 1), elog=elog, log=log,
+        )
+        if cfg.resume:
+            found = ckmgr.resume()
+            if found is not None:
+                rmeta, resume_state, rpath = found
+                resume_done = int(rmeta["tiles_done"])
+                # re-open the solution files append-consistently: drop
+                # any torn trailing rows AND any complete intervals past
+                # the checkpoint (the recomputed tile appends once)
+                for path, validate in (
+                    [(cfg.out_solutions, solio.validate_global_z)]
+                    + [(f"{cfg.out_solutions}.band{i}",
+                        solio.validate_solutions)
+                       for i in range(Nf)]
+                ):
+                    if not _os.path.exists(path):
+                        raise ResumeRefused(
+                            f"checkpoint {rpath} expects solution file "
+                            f"{path}, which does not exist")
+                    v = validate(path, truncate=True,
+                                 max_intervals=resume_done)
+                    if v["n_intervals"] < resume_done:
+                        raise ResumeRefused(
+                            f"{path} holds {v['n_intervals']} intervals "
+                            f"but checkpoint {rpath} expects "
+                            f"{resume_done}")
+
     # solution files: global Z + per-band J (slave :959-979 analog);
     # every handle is registered with the caller's finally-block
-    zfh = open(cfg.out_solutions, "w")
+    zfh = open(cfg.out_solutions, "a" if resume_done else "w")
     open_files.append(zfh)
-    write_global_z_header(zfh, freq0, cfg.npoly, N, M, M * nchunk_max)
+    if not resume_done:
+        write_global_z_header(zfh, freq0, cfg.npoly, N, M, M * nchunk_max)
     band_fhs = []
     for i, path in enumerate(datasets):
-        fh = open(f"{cfg.out_solutions}.band{i}", "w")
+        fh = open(f"{cfg.out_solutions}.band{i}",
+                  "a" if resume_done else "w")
         open_files.append(fh)
-        solio.write_header(
-            fh, metas[i].freq0, metas[i].deltaf,
-            metas[i].deltat * cfg.tilesz / 60.0, N, M, M * nchunk_max,
-        )
+        if not resume_done:
+            solio.write_header(
+                fh, metas[i].freq0, metas[i].deltaf,
+                metas[i].deltat * cfg.tilesz / 60.0, N, M, M * nchunk_max,
+            )
         band_fhs.append(fh)
 
     eye = jones_to_params(identity_jones(
@@ -401,11 +475,24 @@ def _run_distributed_inner(
     ).astype(dtype)
 
     traces = []
+    zdiff_carry = None
+    if resume_state is not None:
+        # warm-start from the checkpointed carry; restore the completed
+        # tiles' residual traces so the return value covers the whole run
+        p_bands = jnp.asarray(resume_state["p_bands"], dtype)
+        traces = [
+            (np.asarray(d), np.asarray(p))
+            for d, p in zip(resume_state["traces_dual"],
+                            resume_state["traces_primal"])
+        ]
+        if "zdiff" in resume_state:
+            zdiff_carry = jnp.asarray(resume_state["zdiff"], dtype)
     tile_starts = list(range(0, ntime, cfg.tilesz))
     pairs = [(i, t0) for i, t0 in enumerate(tile_starts)
              if i >= cfg.skip_tiles]
     if cfg.max_tiles:
         pairs = pairs[: cfg.max_tiles]
+    pairs = pairs[resume_done:]
     # Per-band background prefetch of the FULL-SIZE tiles (the final
     # clamped partial tile loads directly): each band's next tile reads
     # while the mesh ADMM solves the current one (TilePrefetcher,
@@ -495,7 +582,24 @@ def _run_distributed_inner(
         return datas, cdatas, fratios
 
     pf_iters = []
-    zdiff_carry = None
+
+    def _ckpt_update(pi):
+        """End-of-tile checkpoint: everything the loop carries across
+        tiles, materialized to host numpy so a later signal-time flush
+        never touches the device."""
+        if ckmgr is None:
+            return
+        arrs = {
+            "p_bands": np.asarray(p_bands),
+            "traces_dual": np.asarray([d for d, _ in traces]),
+            "traces_primal": np.asarray([p for _, p in traces]),
+        }
+        if zdiff_carry is not None:
+            arrs["zdiff"] = np.asarray(zdiff_carry)
+        ckmgr.update(resume_done + pi, arrs,
+                     tiles_done=resume_done + pi + 1,
+                     run_id=manifest.run_id)
+
     # root span for the whole run; manual enter so the existing
     # try/finally owns the exit (tile + phase spans nest under it)
     run_span = tracer.span("distributed", kind="run", bands=Nf, ndev=ndev,
@@ -506,7 +610,7 @@ def _run_distributed_inner(
       prepared = None
       if pairs:
         with timer.phase("prepare"):
-            prepared = _prepare_tile(pairs[0][1], None)
+            prepared = _prepare_tile(pairs[0][1], zdiff_carry)
       for pi, (tile_no, t0) in enumerate(pairs):
         tic = time.time()
         tile_span = tracer.span("tile", kind="tile", tile=t0)
@@ -591,6 +695,7 @@ def _run_distributed_inner(
         traces.append(
             (np.asarray(out.dual_res), np.asarray(out.primal_res))
         )
+        _ckpt_update(pi)
         if elog is not None:
             # one event per tile = one consensus run of nadmm rounds;
             # band-resolved residuals + the rho trajectory when the mesh
@@ -641,6 +746,9 @@ def _run_distributed_inner(
         )
         tile_span.__exit__(None, None, None)
       log(f"phases: {timer.run_summary()}")
+      if ckmgr is not None:
+          ckmgr.flush()
+          ckmgr.close()
       audit.__exit__(None, None, None)
       if elog is not None:
           from sagecal_tpu.obs.contracts import emit_contract_events

@@ -168,31 +168,116 @@ def _run_inner(cfg, datasets, handles, open_files, log, nadmm, epochs,
         N, np.complex128 if cfg.use_f64 else np.complex64))
     p_init = jnp.broadcast_to(eye, (M, nchunk_max, n8)).astype(dtype)
 
+    # elastic execution (sagecal_tpu/elastic/): the whole FederatedState
+    # pytree (p/Y/Z/Zbar/X + LBFGS memory) is the only cross-tile carry,
+    # so per-tile checkpoints of its flattened leaves make a restart
+    # resume exactly where the killed run stopped
+    ckmgr = None
+    resume_state = None
+    resume_done = 0  # completed tiles
+    if cfg.resume or cfg.checkpoint_every > 0:
+        import os as _os
+
+        from sagecal_tpu.elastic import (
+            CheckpointManager,
+            ResumeRefused,
+            config_fingerprint,
+        )
+
+        fingerprint = config_fingerprint(
+            app="federated",
+            datasets=[_os.path.abspath(p) for p in datasets],
+            sky_model=_os.path.abspath(cfg.sky_model),
+            cluster_file=_os.path.abspath(cfg.cluster_file),
+            nstations=N, ntime=ntime, nbands=Nf,
+            freqs=[float(f) for f in freqs],
+            nadmm=nadmm, epochs=epochs, minibatches=minibatches,
+            tilesz=cfg.tilesz, npoly=cfg.npoly, poly_type=cfg.poly_type,
+            admm_rho=cfg.admm_rho, alpha=alpha, robust_nu=robust_nu,
+            reset_ratio=reset_ratio, max_lbfgs=cfg.max_lbfgs,
+            lbfgs_m=cfg.lbfgs_m, use_f64=cfg.use_f64,
+            in_column=cfg.in_column,
+        )
+        ckmgr = CheckpointManager(
+            cfg.checkpoint_dir or f"{cfg.out_solutions}.ckpt",
+            fingerprint, "federated", every=max(cfg.checkpoint_every, 1),
+            elog=elog, log=log,
+        )
+        if cfg.resume:
+            found = ckmgr.resume()
+            if found is not None:
+                rmeta, resume_state, rpath = found
+                resume_done = int(rmeta["tiles_done"])
+                for i in range(Nf):
+                    path = f"{cfg.out_solutions}.band{i}"
+                    if not _os.path.exists(path):
+                        raise ResumeRefused(
+                            f"checkpoint {rpath} expects solution file "
+                            f"{path}, which does not exist")
+                    v = solio.validate_solutions(
+                        path, truncate=True, max_intervals=resume_done)
+                    if v["n_intervals"] < resume_done:
+                        raise ResumeRefused(
+                            f"{path} holds {v['n_intervals']} intervals "
+                            f"but checkpoint {rpath} expects "
+                            f"{resume_done}")
+
     # per-band solution files
     band_fhs = []
     for i, path in enumerate(datasets):
-        fh = open(f"{cfg.out_solutions}.band{i}", "w")
+        fh = open(f"{cfg.out_solutions}.band{i}",
+                  "a" if resume_done else "w")
         open_files.append(fh)
-        solio.write_header(
-            fh, metas[i].freq0, metas[i].deltaf,
-            metas[i].deltat * cfg.tilesz / 60.0, N, M, M * nchunk_max,
-        )
+        if not resume_done:
+            solio.write_header(
+                fh, metas[i].freq0, metas[i].deltaf,
+                metas[i].deltat * cfg.tilesz / 60.0, N, M, M * nchunk_max,
+            )
         band_fhs.append(fh)
 
     tmb = -(-cfg.tilesz // minibatches)  # time per minibatch (slave:138)
     results = []
     state = init_federated_state(Nf, M, nchunk_max, n8, cfg.npoly,
                                  cfg.lbfgs_m or 7, dtype)
+    if resume_state is not None:
+        from sagecal_tpu.elastic import unflatten_state
+
+        # the freshly-initialized state is the unflatten template (same
+        # treedef); restore the carried pytree + per-tile results
+        state = unflatten_state("state", resume_state, state)
+        rr = resume_state["results_resets"]
+        results = [
+            (np.asarray(resume_state[f"results_dres.{i}"]), int(rr[i]))
+            for i in range(len(rr))
+        ]
     spec = dict(average_channels=True, min_uvcut=cfg.min_uvcut,
                 max_uvcut=cfg.max_uvcut, dtype=dtype,
                 column=cfg.in_column)
 
     from sagecal_tpu.parallel.mesh import stack_for_mesh
 
+    def _ckpt_update(ti):
+        """End-of-tile checkpoint: the FederatedState leaves plus the
+        per-tile (dual-res trace, resets) results, host-materialized so
+        a signal-time flush never touches the device."""
+        if ckmgr is None:
+            return
+        from sagecal_tpu.elastic import flatten_state
+
+        arrs = dict(flatten_state("state", state))
+        arrs["results_resets"] = np.asarray(
+            [r for _, r in results], np.int64)
+        for i, (d, _) in enumerate(results):
+            arrs[f"results_dres.{i}"] = np.asarray(d)
+        ckmgr.update(resume_done + ti, arrs,
+                     tiles_done=resume_done + ti + 1,
+                     run_id=manifest.run_id)
+
     run_span = tracer.span("federated", kind="run", bands=Nf,
                            nadmm=nadmm, epochs=epochs)
     run_span.__enter__()
-    for t0 in range(0, ntime, cfg.tilesz):
+    tile_starts = list(range(0, ntime, cfg.tilesz))[resume_done:]
+    for ti, t0 in enumerate(tile_starts):
         tic = time.time()
         tile_span = tracer.span("tile", kind="tile", tile=t0)
         tile_span.__enter__()
@@ -270,6 +355,10 @@ def _run_inner(cfg, datasets, handles, open_files, log, nadmm, epochs,
         log(f"tile {t0}: dual {dres_trace[-1]:.3e} "
             f"resets {resets_total} ({time.time() - tic:.1f}s)")
         results.append((np.asarray(dres_trace), resets_total))
+        _ckpt_update(ti)
+    if ckmgr is not None:
+        ckmgr.flush()
+        ckmgr.close()
     run_span.__exit__(None, None, None)
     close_tracer()
     if elog is not None:

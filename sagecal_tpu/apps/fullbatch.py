@@ -201,13 +201,79 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
     configure_tracer(run_id=manifest.run_id)
     tracer = get_tracer()
 
+    # elastic execution (sagecal_tpu/elastic/): checkpoint at tile
+    # boundaries, resume from the newest valid checkpoint.  The RNG key
+    # chain is explicit so a resumed tile sees the exact key the
+    # uninterrupted run would have used.
+    import jax
+
+    rng_key = jax.random.PRNGKey(0)
+    ckmgr = None
+    resume_done = 0  # pairs completed (and intervals on disk) at resume
+    results = []
+    if cfg.simulation_mode == 0 and (cfg.resume or cfg.checkpoint_every > 0):
+        from sagecal_tpu.elastic.checkpoint import (
+            CheckpointManager, ResumeRefused, config_fingerprint,
+        )
+        import os as _os
+
+        fingerprint = config_fingerprint(
+            app="fullbatch", dataset=_os.path.abspath(cfg.dataset),
+            sky_model=_os.path.abspath(cfg.sky_model),
+            cluster_file=_os.path.abspath(cfg.cluster_file),
+            nstations=N, ntime=meta.ntime, nchan=meta.nchan,
+            freq0=meta.freq0, n_clusters=M, nchunk_max=nchunk_max,
+            tilesz=cfg.tilesz, solver_mode=cfg.solver_mode,
+            max_emiter=cfg.max_emiter, max_iter=cfg.max_iter,
+            max_lbfgs=cfg.max_lbfgs, lbfgs_m=cfg.lbfgs_m,
+            nulow=cfg.nulow, nuhigh=cfg.nuhigh, randomize=cfg.randomize,
+            use_f64=cfg.use_f64, whiten=cfg.whiten,
+            in_column=cfg.in_column, skip_tiles=cfg.skip_tiles,
+            max_tiles=cfg.max_tiles, init_solutions=cfg.init_solutions,
+        )
+        ckmgr = CheckpointManager(
+            cfg.checkpoint_dir or f"{cfg.out_solutions}.ckpt",
+            fingerprint, "fullbatch",
+            every=max(cfg.checkpoint_every, 1), elog=elog, log=log)
+        if cfg.resume:
+            found = ckmgr.resume()
+            if found is not None:
+                rmeta, rarr, rpath = found
+                resume_done = int(rmeta["tiles_done"])
+                p = jnp.asarray(rarr["p"])
+                rng_key = jnp.asarray(rarr["rng_key"])
+                results = [tuple(map(float, r))
+                           for r in rarr.get("results",
+                                             np.zeros((0, 2)))]
+                v = None
+                if _os.path.exists(cfg.out_solutions):
+                    v = solio.validate_solutions(
+                        cfg.out_solutions, truncate=True,
+                        max_intervals=resume_done)
+                if v is None or v["n_intervals"] < resume_done:
+                    raise ResumeRefused(
+                        f"checkpoint {rpath} records {resume_done} "
+                        f"completed tiles but {cfg.out_solutions} holds "
+                        f"{0 if v is None else v['n_intervals']} intact "
+                        f"intervals; solution file and checkpoint "
+                        f"disagree")
+                log(f"resume: {resume_done} tiles from {rpath}"
+                    + (" (torn interval truncated)"
+                       if v["truncated"] else ""))
+
     sol_fh = None
     if cfg.simulation_mode == 0:
-        sol_fh = open(cfg.out_solutions, "w")
-        solio.write_header(
-            sol_fh, meta.freq0, meta.deltaf, meta.deltat * cfg.tilesz / 60.0,
-            N, M, M * nchunk_max,
-        )
+        if resume_done:
+            # append-consistent re-open: the file was validated (and
+            # any torn/post-checkpoint interval truncated) above
+            sol_fh = open(cfg.out_solutions, "a")
+        else:
+            sol_fh = open(cfg.out_solutions, "w")
+            solio.write_header(
+                sol_fh, meta.freq0, meta.deltaf,
+                meta.deltat * cfg.tilesz / 60.0,
+                N, M, M * nchunk_max,
+            )
 
     def _cdata(dat, t0, fdelta=None):
         """Cluster coherencies, beam-aware when -B is on
@@ -251,15 +317,17 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
     audit = TransferAudit()
     audit.__enter__()
 
-    results = []
     # -K/-T partial reruns (MPI/main.cpp:133-139) resolved up front so
-    # the prefetcher reads exactly the tiles the loop will consume
+    # the prefetcher reads exactly the tiles the loop will consume;
+    # resume additionally drops the pairs the checkpointed run already
+    # completed (their intervals are on disk)
     pairs = [
         (i, t0) for i, t0 in enumerate(ds.tiles(cfg.tilesz))
         if i >= cfg.skip_tiles
     ]
     if cfg.max_tiles:
         pairs = pairs[: cfg.max_tiles]
+    pairs = pairs[resume_done:]
     load_kw = dict(min_uvcut=cfg.min_uvcut, max_uvcut=cfg.max_uvcut,
                    dtype=dtype, column=cfg.in_column)
     specs = [dict(average_channels=False, **load_kw)]
@@ -300,6 +368,19 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
           )
           cdata_ = None if cfg.simulation_mode else _cdata(data_, t0)
           return full_, data_, cdata_full_, cdata_
+
+      def _ckpt_update(pi):
+          """End-of-tile checkpoint: the tile's solution interval and
+          residuals are durable, so (p, rng chain, results) at this
+          boundary is a complete resume point."""
+          if ckmgr is None:
+              return
+          ckmgr.update(
+              resume_done + pi,
+              {"p": np.asarray(p), "rng_key": np.asarray(rng_key),
+               "results": np.asarray(results, np.float64).reshape(-1, 2)},
+              tiles_done=resume_done + pi + 1, run_id=manifest.run_id,
+          )
 
       prepared = None
       if pairs:
@@ -348,7 +429,7 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
             # dispatch to the default device — complex never crosses, so
             # this runs on the axon TPU as-is (solvers/sage.py
             # sagefit_packed)
-            out = solve_tile(data, cdata, p, scfg,
+            out = solve_tile(data, cdata, p, scfg, key=rng_key,
                              device=accel)  # async dispatch
         # overlap: next tile's load + coherency dispatch runs while the
         # device solves this tile
@@ -364,6 +445,10 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
         # out.p comes home as real numpy so all downstream eager math
         # (params_to_jones, residuals) stays on the CPU device
         p = pinit if diverged else jnp.asarray(np.asarray(out.p))
+        # advance the tile RNG chain (the tile just solved used the
+        # pre-advance key; a resumed run restores this chain from the
+        # checkpoint, so resume == uninterrupted bit-for-bit)
+        rng_key = jax.random.fold_in(rng_key, tile_no)
         if diverged:
             log(f"tile {t0}: diverged ({res0:.3e} -> {res1:.3e}), reset")
 
@@ -410,6 +495,7 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
             log(f"tile {t0}: influence diagnostics written "
                 f"({time.time()-tic:.1f}s)")
             results.append((float(out.res_0), float(out.res_1)))
+            _ckpt_update(pi)
             tile_span.__exit__(None, None, None)
             continue
 
@@ -450,13 +536,32 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
                 )))
         with timer.phase("write"):
             ds.write_tile(t0, np.asarray(res), column=cfg.out_column)
+        # warm-start accounting: gains carry tile-to-tile (temporal
+        # smoothness), so iterations-to-converge per tile is the
+        # measured win; gauge + tile_done field feed `diag prom` and
+        # the bench's warm_start_speedup
+        warm_start = bool(pi > 0 or resume_done > 0
+                          or cfg.init_solutions)
+        iters_tile = None
+        conv_recs = sage_convergence_records(out.telemetry)
+        if conv_recs:
+            iters_tile = int(sum(int(r.get("iterations", 0))
+                                 for r in conv_recs))
+            from sagecal_tpu.obs.registry import get_registry
+
+            get_registry().gauge_set(
+                "tile_iterations_to_converge", iters_tile,
+                help="summed solver iterations of this tile's solve "
+                     "(warm starts shrink it)", tile=str(t0),
+                warm_start=str(int(warm_start)))
         if elog is not None:
-            for rec in sage_convergence_records(out.telemetry):
+            for rec in conv_recs:
                 elog.emit("cluster_convergence", tile=t0, **rec)
             elog.emit(
                 "tile_done", tile=t0, res0=res0, res1=res1,
                 mean_nu=float(out.mean_nu), diverged=bool(diverged),
                 seconds=time.time() - tic,
+                warm_start=warm_start, iterations=iters_tile,
                 phase_seconds=timer.tile_timings(),
             )
         log(
@@ -465,6 +570,7 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
             f"[{timer.tile_summary()}]"
         )
         results.append((res0, res1))
+        _ckpt_update(pi)
         note_activity("tile", name=f"tile{t0}", seconds=time.time() - tic)
         tile_span.__exit__(None, None, None)
 
@@ -502,6 +608,11 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
     dump_memory_profile()
     if sol_fh:
         sol_fh.close()
+    if ckmgr is not None:
+        # persist the final boundary even with a sparse cadence, then
+        # unhook from the crash handlers (run is complete)
+        ckmgr.flush()
+        ckmgr.close()
     ds.close()
     # success path only: leaves the final "closed" heartbeat; a crash
     # keeps the recorder alive for the excepthook's dump

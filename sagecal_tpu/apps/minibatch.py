@@ -162,6 +162,50 @@ def _run_minibatch(cfg: RunConfig, log, audit):
     configure_tracer(run_id=manifest.run_id)
     tracer = get_tracer()
 
+    # elastic execution (sagecal_tpu/elastic/): checkpoints at
+    # (epoch, minibatch) boundaries carry p_bands (+ consensus Z and the
+    # Y duals).  The LBFGS curvature memory is deliberately NOT
+    # checkpointed — it rebuilds within a few batches — so a resumed run
+    # converges to the same answer but is not bit-for-bit identical to
+    # an uninterrupted one (the elastic bit-exactness contract covers
+    # the fullbatch and distributed drivers).
+    ckmgr = None
+    resume_done = 0  # completed (epoch, minibatch) steps
+    if cfg.resume or cfg.checkpoint_every > 0:
+        import os as _os
+
+        from sagecal_tpu.elastic import CheckpointManager, config_fingerprint
+
+        fingerprint = config_fingerprint(
+            app="minibatch",
+            dataset=_os.path.abspath(cfg.dataset),
+            sky_model=_os.path.abspath(cfg.sky_model),
+            cluster_file=_os.path.abspath(cfg.cluster_file),
+            nstations=N, ntime=ntime, nchan=meta.nchan,
+            bands=cfg.bands, epochs=cfg.epochs, minibatches=nb,
+            admm_iters=cfg.admm_iters, npoly=cfg.npoly,
+            poly_type=cfg.poly_type, admm_rho=cfg.admm_rho,
+            solver_mode=cfg.solver_mode, max_lbfgs=cfg.max_lbfgs,
+            lbfgs_m=cfg.lbfgs_m, nulow=cfg.nulow, nuhigh=cfg.nuhigh,
+            use_f64=cfg.use_f64, in_column=cfg.in_column,
+        )
+        ckmgr = CheckpointManager(
+            cfg.checkpoint_dir or f"{cfg.out_solutions}.ckpt",
+            fingerprint, "minibatch", every=max(cfg.checkpoint_every, 1),
+            elog=elog, log=log,
+        )
+        if cfg.resume:
+            found = ckmgr.resume()
+            if found is not None:
+                rmeta, rarrs, _rpath = found
+                resume_done = int(rmeta["steps_done"])
+                p_bands = [jnp.asarray(a, dtype)
+                           for a in rarrs["p_bands"]]
+                if consensus_mode:
+                    Z = jnp.asarray(rarrs["Z"], dtype)
+                    Y_bands = [jnp.asarray(a, dtype)
+                               for a in rarrs["Y_bands"]]
+
     def solve_band(bi, data_band, cdata_band):
         p1, mem1 = bfgsfit_minibatch(
             data_band, cdata_band, p_bands[bi],
@@ -176,6 +220,9 @@ def _run_minibatch(cfg: RunConfig, log, audit):
     run_span.__enter__()
     for epoch in range(max(cfg.epochs, 1)):
         for mb in range(nb):
+            step = epoch * nb + mb
+            if step < resume_done:
+                continue  # completed before the checkpoint we resumed
             t0, t1 = int(tedges[mb]), int(tedges[mb + 1])
             if t1 <= t0:
                 continue
@@ -347,9 +394,21 @@ def _run_minibatch(cfg: RunConfig, log, audit):
             if elog is not None:
                 elog.emit("minibatch_done", epoch=epoch, minibatch=mb,
                           t0=t0, t1=t1, seconds=time.time() - tic)
+            if ckmgr is not None:
+                arrs = {"p_bands": np.stack(
+                    [np.asarray(p) for p in p_bands])}
+                if consensus_mode:
+                    arrs["Z"] = np.asarray(Z)
+                    arrs["Y_bands"] = np.stack(
+                        [np.asarray(y) for y in Y_bands])
+                ckmgr.update(step, arrs, steps_done=step + 1,
+                             run_id=manifest.run_id)
             log(f"epoch {epoch} minibatch {mb}: "
                 f"({time.time()-tic:.1f}s)")
 
+    if ckmgr is not None:
+        ckmgr.flush()
+        ckmgr.close()
     # final residuals per band (minibatch_mode.cpp final epoch), streamed
     # tile-by-tile with the same time edges as the training loop — the
     # reference streams per tile; loading the whole observation at once

@@ -1,0 +1,24 @@
+"""Elastic execution: crash-consistent checkpoint/resume for long runs.
+
+Long LOFAR/SKA calibration runs on preemptible TPU pods must survive
+restarts.  The flight recorder (obs/flight.py) DETECTS hangs, SIGTERM
+and crashes; this package lets a restarted run RECOVER: per-tile solver
+state (gain bundles, ADMM Z/duals/rho, RNG keys) is checkpointed
+atomically at tile boundaries, a restart with ``--resume`` derives the
+effective skip count from the newest valid checkpoint, truncates any
+torn trailing solution interval, and warm-starts from the checkpointed
+gains — which also cuts per-tile iterations because gains drift slowly
+(temporal smoothness; ROADMAP item 4).
+"""
+
+from sagecal_tpu.elastic.checkpoint import (  # noqa: F401
+    CHECKPOINT_SCHEMA_VERSION,
+    CheckpointManager,
+    ResumeRefused,
+    config_fingerprint,
+    find_latest_checkpoint,
+    flatten_state,
+    read_checkpoint,
+    unflatten_state,
+    write_checkpoint,
+)

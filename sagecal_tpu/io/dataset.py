@@ -35,6 +35,7 @@ HDF5 layout (all datasets chunked by timeslot for tile streaming):
 from __future__ import annotations
 
 import dataclasses
+import time as _time
 from typing import Optional
 
 import h5py
@@ -234,6 +235,25 @@ class VisDataset:
         return range(0, m.ntime, tilesz)
 
 
+# Live prefetchers, for the crash path (obs/flight.py SIGTERM /
+# excepthook): a preempted run must be able to reap reader threads
+# without unwinding to each app's finally block, so the checkpoint
+# flush is never stuck behind thread teardown.  Entries register in
+# __enter__ and leave in __exit__.
+_ACTIVE_PREFETCHERS: list = []
+
+
+def cancel_active_prefetchers() -> None:
+    """Cancel + join every live TilePrefetcher worker (bounded wait;
+    workers are daemon threads, so a reader wedged inside HDF5 cannot
+    block process exit either way)."""
+    for pf in list(_ACTIVE_PREFETCHERS):
+        try:
+            pf.cancel()
+        except Exception:
+            pass
+
+
 class TilePrefetcher:
     """Background-thread tile prefetch: overlaps the HDF5 read +
     host-side packing of the NEXT tile with the solve of the current
@@ -311,13 +331,38 @@ class TilePrefetcher:
     def __enter__(self):
         self._thread.start()
         self._started = True
+        if self not in _ACTIVE_PREFETCHERS:
+            _ACTIVE_PREFETCHERS.append(self)
         return self
+
+    def cancel(self, join_timeout: float = 2.0) -> None:
+        """Stop the worker and drain its queue with a BOUNDED wait —
+        the crash-path variant of ``__exit__`` (obs/flight.py calls
+        this via :func:`cancel_active_prefetchers`): a dying process
+        must not wait behind a long HDF5 read, only give the worker a
+        chance to notice the stop event and release its handle."""
+        self._stop.set()
+        if not self._started:
+            return
+        deadline = _time.monotonic() + max(join_timeout, 0.1)
+        while self._thread.is_alive() and _time.monotonic() < deadline:
+            try:
+                item = self._q.get(timeout=0.1)
+                if item is self._SENTINEL:
+                    break
+            except Exception:
+                continue
+        self._thread.join(timeout=max(deadline - _time.monotonic(), 0.1))
 
     def __exit__(self, *exc):
         # signal cancellation, then drain so the worker can exit even on
         # early break (without the event it would load every remaining
         # tile before seeing the sentinel consumed)
         self._stop.set()
+        try:
+            _ACTIVE_PREFETCHERS.remove(self)
+        except ValueError:
+            pass
         if self._started:
             while self._thread.is_alive():
                 try:

@@ -173,6 +173,19 @@ def _cmd_events(args) -> int:
                        for e in tiles)
         tot = sum(secs) if secs else 0.0
         print(f"  tiles: {len(tiles)} done, {tot:.2f}s in phases")
+    # elastic digest: checkpoint/resume lifecycle (sagecal_tpu/elastic/)
+    ckpts = [e for e in evs if e.get("type") == "checkpoint_written"]
+    if ckpts:
+        last = ckpts[-1]
+        print(f"  checkpoints: {len(ckpts)} written, last "
+              f"{last.get('path', '?')} (tile {last.get('tile_index', '?')})")
+    for e in evs:
+        if e.get("type") == "resume_started":
+            print(f"  resume: started from {e.get('path', '?')} "
+                  f"(tile {e.get('tile_index', '?')})")
+        elif e.get("type") == "resume_refused":
+            print(f"  resume: REFUSED - {e.get('mismatch', '?')} mismatch "
+                  f"vs {e.get('path', '?')}")
     return 0
 
 

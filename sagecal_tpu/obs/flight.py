@@ -20,8 +20,11 @@ uncaught exception, the interesting state is *inside* the process.  A
   the run, and records ``stall_resolved`` if activity resumes;
 - crash handlers: :func:`install_crash_handlers` chains a process-wide
   ``sys.excepthook`` and a SIGTERM handler that write a flight dump,
-  flush every registered JSONL event log with a ``run_aborted`` event
-  carrying the dump path, then defer to the previous handler.
+  run every registered crash flusher (the elastic checkpoint manager
+  registers one, so a preempted run persists its last completed tile),
+  reap active tile-prefetch threads, flush every registered JSONL
+  event log with a ``run_aborted`` event carrying the dump path, then
+  defer to the previous handler.
 
 Everything is host-side, stdlib-only at import time, and inert unless
 ``SAGECAL_FLIGHT=1`` (crash handlers still flush event logs without a
@@ -76,6 +79,26 @@ def _jsonable(x):
     from sagecal_tpu.obs.events import _jsonable as ev_jsonable
 
     return ev_jsonable(x)
+
+
+# last elastic checkpoint written/resumed in this process; flight dumps
+# and heartbeats carry it so `diag flight` can point an operator at the
+# exact file a `--resume` restart will pick up
+_LAST_CHECKPOINT: Optional[str] = None
+
+
+def note_checkpoint(path: str) -> None:
+    """Record the most recent checkpoint path (elastic/checkpoint.py
+    calls this on every write and on resume)."""
+    global _LAST_CHECKPOINT
+    _LAST_CHECKPOINT = path
+    fr = _GLOBAL
+    if fr is not None:
+        fr.record("checkpoint", name=os.path.basename(path), path=path)
+
+
+def last_checkpoint_path() -> Optional[str]:
+    return _LAST_CHECKPOINT
 
 
 def _atomic_write_json(path: str, doc: dict) -> None:
@@ -213,6 +236,7 @@ class FlightRecorder:
             "stalled": self._stalled,
             "ring_len": len(self._ring),
             "closed": closed,
+            "last_checkpoint": _LAST_CHECKPOINT,
         }
         try:
             _atomic_write_json(self.heartbeat_path, doc)
@@ -280,6 +304,7 @@ class FlightRecorder:
             "threads": _thread_stacks(),
             "ring": self.snapshot(),
             "device_state": _device_state(),
+            "last_checkpoint": _LAST_CHECKPOINT,
         }
         if exc_info is not None:
             tp, val, tb = exc_info
@@ -375,8 +400,47 @@ def _flush_event_logs(reason: str, dump_path: Optional[str]) -> None:
         try:
             if getattr(elog, "closed", False):
                 continue
-            elog.emit("run_aborted", reason=reason, flight_dump=dump_path)
+            elog.emit("run_aborted", reason=reason, flight_dump=dump_path,
+                      last_checkpoint=_LAST_CHECKPOINT)
             elog.close()
+        except Exception:
+            pass
+
+
+# Crash flushers run BEFORE the event logs close so their own events
+# (checkpoint_written) still land in the log; the elastic checkpoint
+# manager is the canonical registrant.  Same plain-list pattern as
+# _EVENT_LOGS.
+_CRASH_FLUSHERS: List[Any] = []
+
+
+def register_crash_flusher(fn) -> None:
+    """Register a zero-arg callable invoked from the SIGTERM/excepthook
+    path (exceptions swallowed — a flusher must never mask the crash)."""
+    if fn is not None and fn not in _CRASH_FLUSHERS:
+        _CRASH_FLUSHERS.append(fn)
+
+
+def unregister_crash_flusher(fn) -> None:
+    try:
+        _CRASH_FLUSHERS.remove(fn)
+    except ValueError:
+        pass
+
+
+def _run_crash_flushers() -> None:
+    for fn in list(_CRASH_FLUSHERS):
+        try:
+            fn()
+        except Exception:
+            pass
+    # reap tile-prefetch worker threads so teardown can't hang past the
+    # checkpoint flush; guarded on the module being loaded already (the
+    # crash path must never import h5py/jax into a dying process)
+    ds_mod = sys.modules.get("sagecal_tpu.io.dataset")
+    if ds_mod is not None:
+        try:
+            ds_mod.cancel_active_prefetchers()
         except Exception:
             pass
 
@@ -392,6 +456,7 @@ def _crash_dump(reason: str, exc_info=None) -> Optional[str]:
 
 
 def _excepthook(tp, val, tb) -> None:
+    _run_crash_flushers()  # before the dump: it records last_checkpoint
     path = _crash_dump("uncaught_exception", exc_info=(tp, val, tb))
     _flush_event_logs(f"uncaught_exception:{getattr(tp, '__name__', tp)}",
                       path)
@@ -400,6 +465,9 @@ def _excepthook(tp, val, tb) -> None:
 
 
 def _sigterm_handler(signum, frame) -> None:
+    # checkpoint first: the dump/flush below is forensics, the flusher
+    # is the state a `--resume` restart needs to exist
+    _run_crash_flushers()
     path = _crash_dump("sigterm")
     _flush_event_logs("sigterm", path)
     prev = _PREV_SIGTERM
@@ -469,6 +537,10 @@ def format_dump(doc: dict, ring_tail: int = 20) -> str:
     exc = doc.get("exception")
     if exc:
         lines.append(f"exception: {exc.get('type')}: {exc.get('value')}")
+    ckpt = doc.get("last_checkpoint")
+    lines.append(
+        f"last checkpoint: {ckpt} (restart with --resume)" if ckpt
+        else "last checkpoint: none (run had no checkpointing enabled)")
     dev = doc.get("device_state") or {}
     if dev.get("jax_imported"):
         lines.append(

@@ -670,6 +670,7 @@ GATE_HIGHER_BETTER = (
     "value", "vs_baseline", "vs_reference_cpu",
     "analytic_tflops_per_sec", "analytic_hbm_gb_per_sec",
     "mfu_vs_v5e_bf16_peak", "bw_util_vs_v5e_819gbps",
+    "warm_start_speedup",
 )
 GATE_LOWER_BETTER = (
     "xla_cost_analysis_bytes_accessed", "peak_device_memory_bytes",
@@ -679,6 +680,7 @@ GATE_LOWER_BETTER = (
 # --metric name=tol)
 GATE_DEFAULT_METRICS = (
     "value", "xla_cost_analysis_bytes_accessed", "peak_device_memory_bytes",
+    "warm_start_speedup",
 )
 GATE_DEFAULT_TOLERANCE = 0.10
 

@@ -1,0 +1,405 @@
+"""Elastic execution tests (sagecal_tpu/elastic/): checkpoint format
+atomicity + fingerprint refusal, crash-flusher wiring, prefetcher
+teardown, in-process resume bit-exactness for the fullbatch and
+distributed drivers, and subprocess SIGTERM fault injection through the
+real signal path (slow)."""
+
+import math
+import os
+import signal
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from sagecal_tpu.apps.config import RunConfig
+from sagecal_tpu.elastic import (
+    CheckpointManager,
+    ResumeRefused,
+    config_fingerprint,
+    find_latest_checkpoint,
+    flatten_state,
+    read_checkpoint,
+    unflatten_state,
+    write_checkpoint,
+)
+from sagecal_tpu.elastic.checkpoint import checkpoint_path, list_checkpoints
+
+pytestmark = pytest.mark.elastic
+
+SKY = """P1 0 0 0.0 51 0 0.0 2.0 0 0 0 0 0 0 0 0 0 0 150e6
+P2 0 2 0.0 50 30 0.0 1.0 0 0 0 0 0 0 0 0 0 0 150e6
+"""
+CLUSTER = "1 1 P1\n2 1 P2\n"
+
+
+@pytest.fixture()
+def workdir(tmp_path):
+    sky = tmp_path / "t.sky.txt"
+    sky.write_text(SKY)
+    (tmp_path / "t.sky.txt.cluster").write_text(CLUSTER)
+    return tmp_path
+
+
+def _make_dataset(path, nstations=7, ntime=4, nchan=2, seed=0, freq0=150e6):
+    import tempfile
+
+    import h5py
+
+    from sagecal_tpu.io.dataset import simulate_dataset
+    from sagecal_tpu.io.simulate import random_jones
+    from sagecal_tpu.io.skymodel import load_sky
+
+    with tempfile.TemporaryDirectory() as td:
+        skyf = os.path.join(td, "s.txt")
+        open(skyf, "w").write(SKY)
+        open(skyf + ".cluster", "w").write(CLUSTER)
+        clusters, _, _ = load_sky(skyf, skyf + ".cluster",
+                                  0.0, math.radians(51.0), dtype=np.float64)
+    jones = random_jones(2, nstations, seed=3 + seed, amp=0.1,
+                         dtype=np.complex128)
+    simulate_dataset(str(path), nstations=nstations, ntime=ntime,
+                     nchan=nchan, clusters=clusters, jones=jones,
+                     noise_sigma=1e-4, seed=seed,
+                     dec0=math.radians(51.0), freq0=freq0)
+    with h5py.File(str(path), "r+") as f:
+        f.attrs["ra0"] = 0.0
+        f.attrs["dec0"] = math.radians(51.0)
+
+
+def _base_cfg(workdir, out, **kw):
+    base = dict(
+        dataset=str(workdir / "d.h5"), sky_model=str(workdir / "t.sky.txt"),
+        cluster_file=str(workdir / "t.sky.txt.cluster"),
+        out_solutions=str(out), tilesz=2, max_emiter=1, max_iter=4,
+        max_lbfgs=6, solver_mode=1,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+class TestCheckpointFormat:
+    def test_write_read_round_trip(self, tmp_path):
+        p = str(tmp_path / "c.npz")
+        arrays = {"p": np.arange(6.0).reshape(2, 3),
+                  "key": np.asarray([0, 7], np.uint32)}
+        write_checkpoint(p, arrays, {"app": "t", "tile_index": 4})
+        meta, back = read_checkpoint(p)
+        assert meta["app"] == "t" and meta["tile_index"] == 4
+        assert meta["schema_version"] == 1 and "ts" in meta
+        np.testing.assert_array_equal(back["p"], arrays["p"])
+        np.testing.assert_array_equal(back["key"], arrays["key"])
+
+    def test_no_temp_left_behind(self, tmp_path):
+        write_checkpoint(str(tmp_path / "c.npz"), {"a": np.zeros(2)}, {})
+        assert sorted(os.listdir(tmp_path)) == ["c.npz"]
+
+    def test_wrong_schema_refused(self, tmp_path):
+        p = str(tmp_path / "c.npz")
+        write_checkpoint(p, {}, {"schema_version": 99})
+        with pytest.raises(ValueError, match="schema"):
+            read_checkpoint(p)
+
+    def test_reserved_meta_name(self, tmp_path):
+        with pytest.raises(ValueError):
+            write_checkpoint(str(tmp_path / "c.npz"),
+                             {"__meta__": np.zeros(1)}, {})
+
+    def test_find_latest_skips_torn_file(self, tmp_path):
+        d = str(tmp_path)
+        write_checkpoint(checkpoint_path(d, 0), {"a": np.ones(2)},
+                         {"tile_index": 0})
+        open(checkpoint_path(d, 1), "wb").write(b"PK garbage torn")
+        meta, arrays, path = find_latest_checkpoint(d)
+        assert meta["tile_index"] == 0 and path.endswith("ckpt_t000000.npz")
+
+    def test_fingerprint_stable_and_sensitive(self):
+        a = config_fingerprint(dataset="x.h5", tilesz=2)
+        assert a == config_fingerprint(tilesz=2, dataset="x.h5")
+        assert a != config_fingerprint(dataset="x.h5", tilesz=3)
+
+    def test_flatten_unflatten_round_trip(self):
+        tree = {"a": np.arange(3.0), "b": (np.ones(2), np.zeros((2, 2)))}
+        flat = flatten_state("s", tree)
+        assert set(flat) == {"s.0", "s.1", "s.2"}
+        back = unflatten_state("s", flat, tree)
+        np.testing.assert_array_equal(back["a"], tree["a"])
+        np.testing.assert_array_equal(back["b"][1], tree["b"][1])
+
+
+class TestCheckpointManager:
+    def test_cadence_flush_and_retention(self, tmp_path):
+        d = str(tmp_path / "ck")
+        mgr = CheckpointManager(d, "fp", "t", every=2, keep=2)
+        assert mgr.update(0, {"p": np.zeros(1)}, tiles_done=1) is None
+        assert mgr.update(1, {"p": np.ones(1)}, tiles_done=2) is not None
+        # flush with nothing newer is a no-op
+        assert mgr.flush() is None
+        mgr.update(2, {"p": np.full(1, 2.0)}, tiles_done=3)
+        assert mgr.flush() is not None  # cadence not due, flush forces
+        for t in (3, 4, 5):
+            mgr.update(t, {"p": np.zeros(1)}, tiles_done=t + 1)
+        names = [os.path.basename(p) for p in list_checkpoints(d)]
+        assert names == ["ckpt_t000005.npz", "ckpt_t000003.npz"]
+        mgr.close()
+
+    def test_resume_round_trip_and_refusal(self, tmp_path):
+        d = str(tmp_path / "ck")
+        mgr = CheckpointManager(d, "fp-a", "fullbatch")
+        mgr.update(0, {"p": np.arange(4.0)}, tiles_done=1, run_id="r1")
+        mgr.close()
+        again = CheckpointManager(d, "fp-a", "fullbatch")
+        meta, arrays, path = again.resume()
+        assert meta["tiles_done"] == 1 and meta["fingerprint"] == "fp-a"
+        np.testing.assert_array_equal(arrays["p"], np.arange(4.0))
+        again.close()
+        with pytest.raises(ResumeRefused, match="fingerprint"):
+            CheckpointManager(d, "fp-b", "fullbatch").resume()
+        with pytest.raises(ResumeRefused, match="app"):
+            CheckpointManager(d, "fp-a", "distributed").resume()
+
+    def test_resume_empty_dir_is_fresh_start(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "none"), "fp", "t")
+        assert mgr.resume() is None
+
+
+class TestCrashPathWiring:
+    def test_crash_flusher_runs_and_unregisters(self):
+        from sagecal_tpu.obs import flight
+
+        calls = []
+        flight.register_crash_flusher(lambda: calls.append(1))
+        bad = lambda: 1 / 0  # noqa: E731 — flusher errors must be swallowed
+        flight.register_crash_flusher(bad)
+        flight._run_crash_flushers()
+        assert calls == [1]
+        # cleanup: remove both (idempotent for an unknown fn)
+        flight.unregister_crash_flusher(bad)
+        for f in list(flight._CRASH_FLUSHERS):
+            flight.unregister_crash_flusher(f)
+        flight._run_crash_flushers()
+        assert calls == [1]
+
+    def test_note_checkpoint_in_dump(self, tmp_path):
+        from sagecal_tpu.obs import flight
+
+        flight.note_checkpoint(str(tmp_path / "ck" / "ckpt_t000003.npz"))
+        assert flight.last_checkpoint_path().endswith("ckpt_t000003.npz")
+        doc = {"reason": "exception", "ts": 0.0,
+               "last_checkpoint": flight.last_checkpoint_path()}
+        text = flight.format_dump(doc)
+        assert "ckpt_t000003.npz" in text and "--resume" in text
+
+    def test_prefetcher_teardown_on_crash_path(self, tmp_path, workdir):
+        from sagecal_tpu.io import dataset as dsmod
+        from sagecal_tpu.obs import flight
+
+        _make_dataset(workdir / "d.h5")
+        pf = dsmod.TilePrefetcher(
+            str(workdir / "d.h5"), [0, 2],
+            [dict(average_channels=True)], 2, depth=1)
+        pf.__enter__()
+        assert pf in dsmod._ACTIVE_PREFETCHERS
+        flight._run_crash_flushers()  # crash path cancels active prefetchers
+        assert not pf._thread.is_alive()
+        pf.__exit__(None, None, None)  # idempotent after cancel
+        assert pf not in dsmod._ACTIVE_PREFETCHERS
+
+
+class TestCliFlags:
+    def test_resume_flags_parse_into_config(self):
+        from sagecal_tpu.apps.cli import build_parser, config_from_args
+
+        args = build_parser().parse_args(
+            ["-d", "x.h5", "-s", "s.txt", "--resume",
+             "--checkpoint-every", "3", "--checkpoint-dir", "/tmp/ck"])
+        cfg = config_from_args(args)
+        assert cfg.resume and cfg.checkpoint_every == 3
+        assert cfg.checkpoint_dir == "/tmp/ck"
+
+    def test_defaults_off(self):
+        from sagecal_tpu.apps.cli import build_parser, config_from_args
+
+        cfg = config_from_args(build_parser().parse_args(
+            ["-d", "x.h5", "-s", "s.txt"]))
+        assert not cfg.resume and cfg.checkpoint_every == 0
+        assert cfg.checkpoint_dir is None
+
+
+class TestFullbatchResume:
+    def test_resume_is_bit_exact(self, workdir):
+        from sagecal_tpu.apps.fullbatch import run_fullbatch
+
+        _make_dataset(workdir / "d.h5")
+        ref = workdir / "ref.txt"
+        r_ref = run_fullbatch(
+            _base_cfg(workdir, ref, checkpoint_every=1),
+            log=lambda *a: None)
+        out = workdir / "res.txt"
+        run_fullbatch(_base_cfg(workdir, out, checkpoint_every=1),
+                      log=lambda *a: None)
+        # rewind to the end of tile 0: drop the newest checkpoint and
+        # leave a stale extra interval for resume to truncate
+        cks = list_checkpoints(str(out) + ".ckpt")
+        assert len(cks) == 2
+        os.remove(cks[0])
+        r_res = run_fullbatch(
+            _base_cfg(workdir, out, resume=True, checkpoint_every=1),
+            log=lambda *a: None)
+        assert len(r_res) == len(r_ref) == 2
+        assert open(ref).read() == open(out).read()
+        np.testing.assert_array_equal(np.asarray(r_res), np.asarray(r_ref))
+
+    def test_resume_refuses_config_change(self, workdir):
+        from sagecal_tpu.apps.fullbatch import run_fullbatch
+
+        _make_dataset(workdir / "d.h5")
+        out = workdir / "res.txt"
+        run_fullbatch(_base_cfg(workdir, out, checkpoint_every=1),
+                      log=lambda *a: None)
+        with pytest.raises(ResumeRefused):
+            run_fullbatch(
+                _base_cfg(workdir, out, resume=True, max_lbfgs=5),
+                log=lambda *a: None)
+
+    def test_resume_refuses_missing_solution_file(self, workdir):
+        from sagecal_tpu.apps.fullbatch import run_fullbatch
+
+        _make_dataset(workdir / "d.h5")
+        out = workdir / "res.txt"
+        run_fullbatch(_base_cfg(workdir, out, checkpoint_every=1),
+                      log=lambda *a: None)
+        os.remove(out)
+        with pytest.raises(ResumeRefused):
+            run_fullbatch(
+                _base_cfg(workdir, out, resume=True, checkpoint_every=1),
+                log=lambda *a: None)
+
+
+@pytest.mark.slow
+class TestDistributedResume:
+    def test_resume_is_bit_exact(self, workdir):
+        from sagecal_tpu.apps.distributed import run_distributed
+
+        for tag in ("ref", "res"):
+            for bi, f0 in enumerate((150e6, 160e6)):
+                _make_dataset(workdir / f"{tag}.band{bi}.h5", seed=bi,
+                              freq0=f0)
+
+        def cfg(out, **kw):
+            return RunConfig(
+                sky_model=str(workdir / "t.sky.txt"),
+                cluster_file=str(workdir / "t.sky.txt.cluster"),
+                out_solutions=str(out), tilesz=2, max_emiter=1,
+                max_iter=2, admm_iters=2, npoly=2, bands=2, **kw)
+
+        def bandfiles(tag):
+            return [str(workdir / f"{tag}.band{i}.h5") for i in range(2)]
+
+        ref = workdir / "ref.z.txt"
+        t_ref = run_distributed(cfg(ref, checkpoint_every=1),
+                                datasets=bandfiles("ref"),
+                                log=lambda *a: None)
+        out = workdir / "res.z.txt"
+        run_distributed(cfg(out, checkpoint_every=1),
+                        datasets=bandfiles("res"), log=lambda *a: None)
+        cks = list_checkpoints(str(out) + ".ckpt")
+        os.remove(cks[0])
+        t_res = run_distributed(cfg(out, resume=True, checkpoint_every=1),
+                                datasets=bandfiles("res"),
+                                log=lambda *a: None)
+        assert len(t_res) == len(t_ref) == 2
+        assert open(ref).read() == open(out).read()
+        for i in range(2):
+            assert (open(f"{ref}.band{i}").read()
+                    == open(f"{out}.band{i}").read())
+        np.testing.assert_array_equal(
+            np.asarray([t[0] for t in t_res]),
+            np.asarray([t[0] for t in t_ref]))
+
+
+_CHILD = textwrap.dedent("""\
+    import sys, time
+    sys.path.insert(0, {repo!r})
+    from sagecal_tpu.apps.config import RunConfig
+    from sagecal_tpu.apps.fullbatch import run_fullbatch
+
+    def slowlog(*a):
+        print(*a, flush=True)
+        time.sleep(0.4)  # widen the tile-boundary kill window
+
+    cfg = RunConfig(
+        dataset={dataset!r}, sky_model={sky!r}, cluster_file={cluster!r},
+        out_solutions=sys.argv[1], tilesz=2, max_emiter=1, max_iter=4,
+        max_lbfgs=6, solver_mode=1, checkpoint_every=1,
+        resume=("--resume" in sys.argv),
+    )
+    run_fullbatch(cfg, log=slowlog)
+""")
+
+
+@pytest.mark.slow
+class TestSigtermFaultInjection:
+    """Kill a REAL subprocess with SIGTERM (the preemption signal) at a
+    tile boundary and mid-solve, resume, and require the end state to
+    match an uninterrupted run byte-for-byte."""
+
+    def _setup(self, workdir, ntime=8):
+        import time as _time
+
+        from sagecal_tpu.elastic import faultinject as fi
+
+        _make_dataset(workdir / "d.h5", ntime=ntime)
+        child = workdir / "child.py"
+        child.write_text(_CHILD.format(
+            repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            dataset=str(workdir / "d.h5"), sky=str(workdir / "t.sky.txt"),
+            cluster=str(workdir / "t.sky.txt.cluster")))
+        # the reference must come from the SAME child script run
+        # uninterrupted (subprocess float formatting can differ in the
+        # last digit from the in-process pytest environment); its wall
+        # time also calibrates the mid-solve kill delay
+        ref = workdir / "ref.txt"
+        t0 = _time.monotonic()
+        rc, _, err = fi.run_subprocess(
+            [sys.executable, str(child), str(ref)], env=self._env(),
+            timeout=600)
+        assert rc == 0, err
+        ref_secs = _time.monotonic() - t0
+        out = workdir / "res.txt"
+        return ref, out, [sys.executable, str(child), str(out)], ref_secs
+
+    def _env(self):
+        return {"JAX_PLATFORMS": "cpu"}
+
+    def test_kill_at_tile_boundary_then_resume(self, workdir):
+        from sagecal_tpu.elastic import faultinject as fi
+
+        ref, out, cmd, _ = self._setup(workdir)
+        rc, _, err = fi.kill_at_checkpoint(
+            cmd, str(out) + ".ckpt", 2, env=self._env(), timeout=600)
+        assert rc != 0, f"run finished before the kill fired:\n{err}"
+        assert list_checkpoints(str(out) + ".ckpt")
+        rc2, out2, err2 = fi.run_subprocess(
+            cmd + ["--resume"], env=self._env(), timeout=600)
+        assert rc2 == 0, err2
+        assert "resume:" in out2
+        assert open(ref).read() == open(out).read()
+
+    def test_kill_mid_solve_then_resume(self, workdir):
+        # SIGTERM at an arbitrary moment (possibly inside compile or a
+        # device solve): the crash flusher persists the last boundary,
+        # resume recomputes only the interrupted tile — or starts fresh
+        # if the kill landed before the first checkpoint
+        from sagecal_tpu.elastic import faultinject as fi
+
+        ref, out, cmd, ref_secs = self._setup(workdir)
+        rc, _, _ = fi.kill_after_delay(
+            cmd, max(2.0, 0.6 * ref_secs), env=self._env(), timeout=600)
+        if rc == 0:
+            pytest.skip("run finished before the mid-solve kill")
+        rc2, _, err2 = fi.run_subprocess(
+            cmd + ["--resume"], env=self._env(), timeout=600)
+        assert rc2 == 0, err2
+        assert open(ref).read() == open(out).read()

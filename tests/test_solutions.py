@@ -1,0 +1,163 @@
+"""io/solutions.py unit coverage: round trip, crash-safe append, and
+the torn-interval validators behind elastic resume (validate_solutions /
+validate_global_z) — truncated files, empty-interval edge cases, and the
+max_intervals resume cap."""
+
+import numpy as np
+import pytest
+
+from sagecal_tpu.apps.distributed import append_global_z, write_global_z_header
+from sagecal_tpu.io import solutions as solio
+
+pytestmark = pytest.mark.elastic
+
+
+def _jones(ntiles, K=4, N=7, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=(ntiles, K, N, 2, 2))
+            + 1j * rng.normal(size=(ntiles, K, N, 2, 2)))
+
+
+def _write_solution_file(path, jones, N=7, K=4):
+    with open(path, "w") as fh:
+        solio.write_header(fh, 150e6, 0.2e6, 1.0, N, K // 2, K)
+        for t in range(jones.shape[0]):
+            solio.append_solutions(fh, jones[t])
+
+
+class TestRoundTrip:
+    def test_write_read_round_trip(self, tmp_path):
+        p = str(tmp_path / "sol.txt")
+        jones = _jones(3)
+        _write_solution_file(p, jones)
+        meta, back = solio.read_solutions(p)
+        assert meta["nstations"] == 7 and meta["nclus_eff"] == 4
+        assert back.shape == jones.shape
+        # %e prints 6 significant decimals; the round trip is exact to
+        # that precision
+        np.testing.assert_allclose(back, jones, rtol=2e-6, atol=1e-12)
+
+    def test_append_is_single_buffered_write(self, tmp_path):
+        # the crash-safety contract: one fh.write per interval, flushed
+        writes = []
+
+        class Spy:
+            def write(self, s):
+                writes.append(s)
+
+            def flush(self):
+                writes.append(None)
+
+        solio.append_solutions(Spy(), _jones(1)[0])
+        assert writes[-1] is None  # flushed
+        assert len([w for w in writes if w is not None]) == 1
+
+    def test_validate_clean_file(self, tmp_path):
+        p = str(tmp_path / "sol.txt")
+        _write_solution_file(p, _jones(2))
+        v = solio.validate_solutions(p)
+        assert v == {"n_intervals": 2, "torn_rows": 0,
+                     "rows_per_interval": 56, "truncated": False}
+
+
+class TestTornDetection:
+    def test_torn_final_line_truncated(self, tmp_path):
+        p = str(tmp_path / "sol.txt")
+        _write_solution_file(p, _jones(2))
+        data = open(p, "rb").read()
+        open(p, "wb").write(data[:-17])  # cut mid-row, no newline
+        v = solio.validate_solutions(p)
+        assert v["n_intervals"] == 1 and v["torn_rows"] > 0
+        v = solio.validate_solutions(p, truncate=True)
+        assert v["truncated"]
+        # after truncation the file is clean with 1 interval
+        v2 = solio.validate_solutions(p)
+        assert v2 == {"n_intervals": 1, "torn_rows": 0,
+                      "rows_per_interval": 56, "truncated": False}
+        _, back = solio.read_solutions(p)
+        assert back.shape[0] == 1
+
+    def test_partial_interval_complete_lines(self, tmp_path):
+        # a kill between row writes leaves whole lines but a short
+        # interval: the row count modulo 8N exposes it
+        p = str(tmp_path / "sol.txt")
+        _write_solution_file(p, _jones(2))
+        lines = open(p).readlines()
+        open(p, "w").writelines(lines[:-10])
+        v = solio.validate_solutions(p, truncate=True)
+        assert v["n_intervals"] == 1 and v["torn_rows"] == 46
+        assert solio.validate_solutions(p)["torn_rows"] == 0
+
+    def test_counter_out_of_cycle(self, tmp_path):
+        p = str(tmp_path / "sol.txt")
+        _write_solution_file(p, _jones(2))
+        lines = open(p).readlines()
+        # duplicate a row inside the second interval: its counter is now
+        # out of cycle, invalidating that interval onward
+        lines.insert(70, lines[69])
+        open(p, "w").writelines(lines)
+        assert solio.validate_solutions(p)["n_intervals"] == 1
+
+    def test_non_numeric_garbage_row(self, tmp_path):
+        p = str(tmp_path / "sol.txt")
+        _write_solution_file(p, _jones(2))
+        lines = open(p).readlines()
+        toks = lines[60].split()
+        toks[3] = "8e#1"
+        lines[60] = " ".join(toks) + "\n"
+        open(p, "w").writelines(lines)
+        assert solio.validate_solutions(p)["n_intervals"] == 1
+
+
+class TestEdgeCases:
+    def test_empty_interval_file(self, tmp_path):
+        # header only, zero intervals: valid, nothing torn
+        p = str(tmp_path / "sol.txt")
+        _write_solution_file(p, _jones(0))
+        v = solio.validate_solutions(p, truncate=True)
+        assert v["n_intervals"] == 0 and v["torn_rows"] == 0
+        assert not v["truncated"]
+
+    def test_no_header_raises(self, tmp_path):
+        p = str(tmp_path / "sol.txt")
+        open(p, "w").write("# only comments\n")
+        with pytest.raises(ValueError):
+            solio.validate_solutions(p)
+
+    def test_max_intervals_resume_cap(self, tmp_path):
+        # intervals past the checkpoint are complete but about to be
+        # recomputed: the cap drops them so resume appends exactly once
+        p = str(tmp_path / "sol.txt")
+        jones = _jones(3)
+        _write_solution_file(p, jones)
+        v = solio.validate_solutions(p, truncate=True, max_intervals=2)
+        assert v["n_intervals"] == 2 and v["truncated"]
+        _, back = solio.read_solutions(p)
+        assert back.shape[0] == 2
+        np.testing.assert_allclose(back, jones[:2], rtol=2e-6, atol=1e-12)
+
+
+class TestGlobalZ:
+    def _write(self, path, ntiles, N=5, M=2, npoly=2, nchunk=1, seed=0):
+        rng = np.random.default_rng(seed)
+        with open(path, "w") as fh:
+            write_global_z_header(fh, 150e6, npoly, N, M, M * nchunk)
+            for _ in range(ntiles):
+                Z = rng.normal(size=(M, npoly, nchunk * 8 * N))
+                append_global_z(fh, Z, N, npoly, nchunk)
+
+    def test_validate_clean(self, tmp_path):
+        p = str(tmp_path / "z.txt")
+        self._write(p, 2)
+        v = solio.validate_global_z(p)
+        assert v["n_intervals"] == 2 and v["torn_rows"] == 0
+        assert v["rows_per_interval"] == 2 * 8 * 5
+
+    def test_torn_truncate(self, tmp_path):
+        p = str(tmp_path / "z.txt")
+        self._write(p, 2)
+        data = open(p, "rb").read()
+        open(p, "wb").write(data[:-40])
+        v = solio.validate_global_z(p, truncate=True)
+        assert v["n_intervals"] == 1 and v["truncated"]
+        assert solio.validate_global_z(p)["torn_rows"] == 0

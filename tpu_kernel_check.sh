@@ -88,3 +88,46 @@ JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 900 \
   -p no:cacheprovider | tail -3
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then echo "telemetry test pass FAILED rc=$rc"; exit 1; fi
+echo "=== elastic kill-and-resume smoke (CPU)"
+# prove the preemption path end to end: SIGTERM a real calibration right
+# after its first checkpoint lands, then --resume it to completion and
+# require an untorn solution file (sagecal_tpu/elastic/)
+ELDIR="$MANIFEST_DIR/elastic"
+rm -rf "$ELDIR"; mkdir -p "$ELDIR"
+JAX_PLATFORMS=cpu timeout 300 python - "$ELDIR" <<'PY'
+import math, os, sys
+import numpy as np, h5py
+from sagecal_tpu.io.dataset import simulate_dataset
+from sagecal_tpu.io.simulate import random_jones
+from sagecal_tpu.io.skymodel import load_sky
+d = sys.argv[1]
+sky = os.path.join(d, "sky.txt")
+open(sky, "w").write(
+    "P1 0 0 0.0 51 0 0.0 2.0 0 0 0 0 0 0 0 0 0 0 150e6\n"
+    "P2 0 2 0.0 50 30 0.0 1.0 0 0 0 0 0 0 0 0 0 0 150e6\n")
+open(sky + ".cluster", "w").write("1 1 P1\n2 1 P2\n")
+clusters, _, _ = load_sky(sky, sky + ".cluster", 0.0, math.radians(51.0),
+                          dtype=np.float64)
+path = os.path.join(d, "d.h5")
+simulate_dataset(path, nstations=7, ntime=8, nchan=2, clusters=clusters,
+                 jones=random_jones(2, 7, seed=3, amp=0.1,
+                                    dtype=np.complex128),
+                 noise_sigma=1e-4, seed=0, dec0=math.radians(51.0))
+with h5py.File(path, "r+") as f:
+    f.attrs["ra0"] = 0.0
+    f.attrs["dec0"] = math.radians(51.0)
+PY
+[ $? = 0 ] || { echo "elastic smoke dataset build FAILED"; exit 1; }
+ELCAL=(python -m sagecal_tpu.apps.cli -d "$ELDIR/d.h5" -s "$ELDIR/sky.txt"
+       -p "$ELDIR/sol.txt" -t 2 -e 1 -g 4 -l 6 -j 1 --checkpoint-every 1)
+JAX_PLATFORMS=cpu timeout 300 python -m sagecal_tpu.elastic.faultinject \
+  kill-at-ckpt 1 "$ELDIR/sol.txt.ckpt" -- "${ELCAL[@]}" \
+  || { echo "elastic kill step FAILED"; exit 1; }
+# exit 5 here = ResumeRefused (config/data fingerprint drift) - hard stop
+JAX_PLATFORMS=cpu timeout 300 "${ELCAL[@]}" --resume \
+  || { echo "elastic resume FAILED rc=$?"; exit 1; }
+JAX_PLATFORMS=cpu timeout 60 python -c "
+from sagecal_tpu.io.solutions import validate_solutions
+v = validate_solutions('$ELDIR/sol.txt')
+assert v['n_intervals'] == 4 and v['torn_rows'] == 0, v
+print('elastic smoke ok:', v)" || { echo "elastic smoke validate FAILED"; exit 1; }

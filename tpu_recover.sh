@@ -45,6 +45,39 @@ else
   exit 2
 fi
 
+# (a2) elastic resume: if the wedge killed a calibration mid-run, its
+# flight dump records the exact argv and the last durable checkpoint
+# (obs/flight.py crash dump); relaunch it with --resume so only the
+# interrupted tile is recomputed (sagecal_tpu/elastic/).  The dump is
+# renamed after one attempt so a failing resume can't loop.
+RESUME_DUMP=$(ls -1t /root/repo/flight_dump*.json 2>/dev/null | head -1)
+if [ -n "${RESUME_DUMP:-}" ]; then
+  RESUME_CMD=$(python - "$RESUME_DUMP" <<'PY'
+import json, shlex, sys
+try:
+    doc = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(0)
+argv = doc.get("argv") or []
+# only calibrations checkpoint; a dump without one has nothing to resume
+if not doc.get("last_checkpoint") or not argv:
+    sys.exit(0)
+if "--resume" not in argv:
+    argv = argv + ["--resume"]
+print(" ".join(shlex.quote(a) for a in ([sys.executable] + argv)))
+PY
+)
+  if [ -n "${RESUME_CMD:-}" ]; then
+    echo "=== elastic resume of interrupted run at $(date): $RESUME_CMD" >> "$LOG"
+    mv "$RESUME_DUMP" "$RESUME_DUMP.resumed"
+    # argv[0] is the script file itself (python -m rewrites it to the
+    # module path), so the repo root must be importable
+    timeout 14400 env PYTHONPATH="/root/repo${PYTHONPATH:+:$PYTHONPATH}" \
+      bash -c "$RESUME_CMD" > /root/repo/tpu_resume.out 2>&1
+    echo "elastic resume rc=$? at $(date)" >> "$LOG"
+  fi
+fi
+
 # (b) round-5: the kernel ladder, fused bench, bf16 bench and the e2e
 # app are all hardware-validated and banked (bench_tpu_r05*.json,
 # PERF.md); on heal we only re-bank a fresh plain bench as liveness

@@ -4,7 +4,10 @@
 # deadline).  Exits quietly if the watcher ended because it banked, or
 # if a live calibration's flight-recorder heartbeat is fresh — a restart
 # (and the recovery sequence's bench) must never preempt a run that is
-# demonstrably making progress.
+# demonstrably making progress.  A STALE heartbeat with a flight dump on
+# disk means a calibration died mid-run: the restarted tpu_recover.sh
+# relaunches it with --resume from its last checkpoint (elastic
+# execution, sagecal_tpu/elastic/).
 HB="${SAGECAL_HEARTBEAT_FILE:-/root/repo/.sagecal_heartbeat}"
 STALE="${SAGECAL_HEARTBEAT_STALE:-600}"
 hb_fresh() {
