@@ -195,12 +195,20 @@ def make_step(data, cdata, nu=5.0):
 
 
 def make_fused_step(data, nu=5.0, tile=None):
-    """LBFGS step whose cost uses the fused Pallas RIME kernel
-    (ops/rime_kernel.py) instead of the XLA predict path.  Returns
-    (prep, step): ``prep`` pads rows/clusters to kernel alignment ONCE
-    (run it before the timing loop, keep results device-resident);
-    ``step`` takes the padded arrays.  Default on TPU since the round-5
-    hardware validation (SAGECAL_BENCH_FUSED=0 opts back to XLA).
+    """LBFGS step whose VALUE AND GRAD run entirely inside the fused
+    OBJECTIVE kernel (ops/rime_kernel.py fused_cost_packed_chunked):
+    predict, masked residual, Student's-t weighting and the scalar
+    reduction in one pass over the coherency stack — no model-sized
+    buffer ever crosses HBM, forward or backward.  Returns (prep, step):
+    ``prep`` pads rows/clusters to kernel alignment ONCE (run it before
+    the timing loop, keep results device-resident); ``step`` takes the
+    padded arrays.  Default on TPU since the round-5 hardware validation
+    (SAGECAL_BENCH_FUSED=0 opts back to XLA).
+
+    The antenna-index planes are packed on the host and transferred
+    ONCE at make time (device-resident constants reused by every prep/
+    step call — they were previously re-packed per prep call), and
+    stop_gradient lives inside the kernel wrappers, not the step trace.
 
     tile defaults to FULL_CLUSTER_TILE (128, the largest tile whose
     BACKWARD kernel fits the v5e 16 MB scoped-VMEM limit at Mp=104 —
@@ -213,7 +221,7 @@ def make_fused_step(data, nu=5.0, tile=None):
 
     from sagecal_tpu.core.types import params_to_jones
     from sagecal_tpu.ops.rime_kernel import (
-        FULL_CLUSTER_TILE, chunked_rowsp, fused_predict_packed_chunked,
+        FULL_CLUSTER_TILE, chunked_rowsp, fused_cost_packed_chunked,
         pack_gain_tables, pad_to,
     )
     from sagecal_tpu.solvers.lbfgs import lbfgs_fit
@@ -227,6 +235,10 @@ def make_fused_step(data, nu=5.0, tile=None):
     antq = np.zeros((1, rowsp), np.int32)
     antp[0, :rows] = np.asarray(data.ant_p)
     antq[0, :rows] = np.asarray(data.ant_q)
+    # hoisted device-resident constants: one 4-byte-per-row transfer at
+    # make time instead of a re-pack on every prep call
+    antp_d = jnp.asarray(antp)
+    antq_d = jnp.asarray(antq)
 
     @jax.jit
     def prep(vis_ri, mask, coh_ri):
@@ -234,23 +246,22 @@ def make_fused_step(data, nu=5.0, tile=None):
         mask_p = jnp.pad(mask, ((0, 0), (0, rowsp - rows)))
         coh_p = jnp.pad(coh_ri, ((0, mp - M), (0, 0), (0, 0),
                                  (0, rowsp - rows)))
-        return vis_p, mask_p, coh_p, jnp.asarray(antp), jnp.asarray(antq)
+        return vis_p, mask_p, coh_p, antp_d, antq_d
 
     @jax.jit
     def step(vis_p, mask_p, coh_p, antp_d, antq_d, p0):
         # kernel dots are HIGHEST internally; this covers the LBFGS
-        # two-loop/line-search vector algebra (production precision)
+        # two-loop/line-search vector algebra (production precision).
+        # coh/vis/mask stop_gradient happens inside the chunked cost
+        # wrapper (they are constants of the solve).
         with jax.default_matmul_precision("highest"):
-            coh_c = jax.lax.stop_gradient(coh_p)
 
             def cost_fn(pflat):
                 jones = params_to_jones(pflat.reshape(M, 1, n8))[:, 0]
                 tre, tim = pack_gain_tables(jones, mp)
-                model = fused_predict_packed_chunked(
-                    tre, tim, coh_c, antp_d, antq_d, tile)
-                d = (vis_p - model) * mask_p[:, None, :]
-                e2 = d[:, :4, :] ** 2 + d[:, 4:, :] ** 2
-                return jnp.sum(jnp.log1p(e2 / nu))
+                return fused_cost_packed_chunked(
+                    tre, tim, coh_p, antp_d, antq_d, vis_p, mask_p, nu,
+                    tile)
 
             fit = lbfgs_fit(cost_fn, None, p0.reshape(-1),
                             itmax=LBFGS_ITERS, M=7)
@@ -290,8 +301,14 @@ def hbm_bytes_per_cost_eval(tilesz=TILESZ, coh_bytes_per_cplx=8,
 
 
 def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ,
-        measure_warm_start=False):
+        measure_warm_start=False, coh_bf16=None):
+    """One measured bench pass.  ``coh_bf16`` overrides the
+    SAGECAL_BENCH_COH_BF16 env default so main() can re-run the bf16
+    variant row in-process without env mutation."""
     import jax
+
+    if coh_bf16 is None:
+        coh_bf16 = COH_BF16
 
     with jax.default_device(_cpu_device()):
         data, cdata, p0 = build_workload(dtype, tilesz)
@@ -318,7 +335,7 @@ def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ,
     global FUSED
     if _FUSED_ENV is None:
         FUSED = dev.platform not in ("cpu",)
-    if COH_BF16:
+    if coh_bf16:
         import ml_dtypes
 
         # fused path: the kernel upcasts bf16 planes to f32 at the VMEM
@@ -568,6 +585,21 @@ def main():
         )
     xla_flops = perf.get("flops")
 
+    # bf16-coherency variant row: re-run the fused-objective step with
+    # the coherency stack stored bfloat16 (f32 accumulation) so the
+    # stream-halving knob is regression-guarded by `diag gate` alongside
+    # the f32 headline.  Fused path only (the knob halves the kernel's
+    # HBM stream; the XLA path would re-measure a different program),
+    # and skipped when the whole run IS the bf16 run.
+    bf16_variant = None
+    if FUSED and not COH_BF16:
+        with tracer.span("bench", kind="run", platform=platform,
+                         tilesz=tilesz, repeats=1, variant="coh_bf16"):
+            bf16_variant = run(
+                np.float32, repeats=1, want_flops=True, tilesz=tilesz,
+                coh_bf16=True,
+            )
+
     cpu_measured = None
     if os.environ.get("SAGECAL_BENCH_MEASURE_CPU"):
         cpu_measured = _measure_cpu_subprocess(tilesz)
@@ -649,6 +681,14 @@ def main():
         rec["warm_start_iters_cold"] = warm["iters_cold"]
         rec["warm_start_iters_warm"] = warm["iters_warm"]
         rec["warm_start_speedup"] = warm["speedup"]
+    if bf16_variant is not None:
+        # gate-able bf16-coherency row (obs/perf.py knows directions):
+        # throughput higher-better, compiled bytes accessed lower-better
+        v_b, _, _, perf_b, _ = bf16_variant
+        rec["coh_bf16_iters_per_sec"] = round(v_b, 3)
+        if perf_b.get("bytes_accessed"):
+            rec["coh_bf16_xla_cost_analysis_bytes_accessed"] = (
+                perf_b["bytes_accessed"])
     if xla_flops:
         rec["xla_cost_analysis_tflops_per_sec"] = round(xla_flops / dt / 1e12, 4)
     # gate-able absolutes (diag gate): compiled-program bytes accessed

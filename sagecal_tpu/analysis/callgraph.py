@@ -72,6 +72,8 @@ class FuncInfo:
     jit_root: bool = False
     static_argnames: Set[str] = dataclasses.field(default_factory=set)
     static_argnums: Set[int] = dataclasses.field(default_factory=set)
+    donate_argnames: Set[str] = dataclasses.field(default_factory=set)
+    donate_argnums: Set[int] = dataclasses.field(default_factory=set)
     wrap_sites: List[Tuple[str, int]] = dataclasses.field(
         default_factory=list)  # (module, lineno) of each jit wrap
     refs: Set[str] = dataclasses.field(default_factory=set)  # raw dotted
@@ -206,7 +208,8 @@ class CallGraph:
         self.functions: Dict[str, FuncInfo] = {}
         self.reachable: Set[str] = set()
         # pending jit/passthrough wrap call sites:
-        # (modname, scope_qual, target_expr, statics, lineno)
+        # (modname, scope_qual, target_expr, static_names, static_nums,
+        #  donate_names, donate_nums, lineno)
         self._wrap_calls: List[tuple] = []
         # (scope_qual or "", name) -> first-arg expr of a passthrough call
         self._assign_chain: Dict[Tuple[str, str], ast.AST] = {}
@@ -286,6 +289,20 @@ class CallGraph:
                         nums.add(el)
         return names, nums
 
+    def _donates_from_keywords(self, keywords) -> Tuple[Set[str], Set[int]]:
+        names: Set[str] = set()
+        nums: Set[int] = set()
+        for kw in keywords or ():
+            if kw.arg == "donate_argnames":
+                for el in self._const_elts(kw.value):
+                    if isinstance(el, str):
+                        names.add(el)
+            elif kw.arg == "donate_argnums":
+                for el in self._const_elts(kw.value):
+                    if isinstance(el, int):
+                        nums.add(el)
+        return names, nums
+
     @staticmethod
     def _const_elts(node: ast.AST) -> List:
         if isinstance(node, ast.Constant):
@@ -308,6 +325,9 @@ class CallGraph:
                     names, nums = self._statics_from_keywords(call.keywords)
                     fi.static_argnames |= names
                     fi.static_argnums |= nums
+                    dnames, dnums = self._donates_from_keywords(call.keywords)
+                    fi.donate_argnames |= dnames
+                    fi.donate_argnums |= dnums
             elif (call is not None and q in ("functools.partial", "partial")
                   and call.args):
                 inner_q = qual_of(call.args[0], mi.imports, mi.toplevel,
@@ -318,6 +338,9 @@ class CallGraph:
                     names, nums = self._statics_from_keywords(call.keywords)
                     fi.static_argnames |= names
                     fi.static_argnums |= nums
+                    dnames, dnums = self._donates_from_keywords(call.keywords)
+                    fi.donate_argnames |= dnames
+                    fi.donate_argnums |= dnums
 
     def _collect_wraps_and_refs(self, mi: ModuleInfo) -> None:
         for node in ast.walk(mi.tree):
@@ -327,9 +350,10 @@ class CallGraph:
                 q = qual_of(node.func, mi.imports, mi.toplevel, mi.name)
                 if q in JIT_WRAPPERS and node.args:
                     names, nums = self._statics_from_keywords(node.keywords)
+                    dnames, dnums = self._donates_from_keywords(node.keywords)
                     self._wrap_calls.append(
                         (mi.name, scope, node.args[0], names, nums,
-                         node.lineno))
+                         dnames, dnums, node.lineno))
                 elif (q in ("functools.partial", "partial")
                       and len(node.args) >= 2):
                     inner_q = qual_of(node.args[0], mi.imports, mi.toplevel,
@@ -337,9 +361,11 @@ class CallGraph:
                     if inner_q in JIT_WRAPPERS:
                         names, nums = self._statics_from_keywords(
                             node.keywords)
+                        dnames, dnums = self._donates_from_keywords(
+                            node.keywords)
                         self._wrap_calls.append(
                             (mi.name, scope, node.args[1], names, nums,
-                             node.lineno))
+                             dnames, dnums, node.lineno))
             elif isinstance(node, ast.Assign) and isinstance(
                     node.value, ast.Call):
                 # remember `fn = shard_map(local_fit, ...)`-style bindings
@@ -417,7 +443,8 @@ class CallGraph:
 
     def finalize(self) -> None:
         """Resolve wrap call-sites, then close reachability."""
-        for modname, scope, expr, names, nums, lineno in self._wrap_calls:
+        for (modname, scope, expr, names, nums, dnames, dnums,
+             lineno) in self._wrap_calls:
             fi = self._resolve_target(modname, scope, expr)
             if fi is None:
                 continue
@@ -425,6 +452,8 @@ class CallGraph:
             fi.wrap_sites.append((modname, lineno))
             fi.static_argnames |= names
             fi.static_argnums |= nums
+            fi.donate_argnames |= dnames
+            fi.donate_argnums |= dnums
         # BFS over reference edges + lexical nesting
         queue = [q for q, fi in self.functions.items() if fi.jit_root]
         seen: Set[str] = set()

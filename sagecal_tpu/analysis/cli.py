@@ -97,7 +97,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         out = args.baseline or DEFAULT_BASELINE
         baseline_mod.save_baseline(out, findings)
         n = sum(1 for f in findings if not f.report_only)
-        print(f"jaxlint: wrote {n} finding(s) to {out}")
+        n_report = len(findings) - n
+        print(f"jaxlint: wrote {n} finding(s) + {n_report} "
+              f"report-only to {out}")
         return 0
 
     bl = baseline_mod.load_baseline(baseline_path) if baseline_path \

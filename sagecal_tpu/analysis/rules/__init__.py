@@ -16,6 +16,7 @@ from sagecal_tpu.analysis.rules.jl003 import RecompileHazard
 from sagecal_tpu.analysis.rules.jl004 import DtypePolicy
 from sagecal_tpu.analysis.rules.jl005 import DataDependentShape
 from sagecal_tpu.analysis.rules.jl006 import StrayCollective
+from sagecal_tpu.analysis.rules.jl007 import UndonatedCarry
 from sagecal_tpu.analysis.rules.jl900 import DeadImport
 
 
@@ -27,5 +28,6 @@ def all_rules() -> List[Type[Rule]]:
         DtypePolicy,
         DataDependentShape,
         StrayCollective,
+        UndonatedCarry,
         DeadImport,
     ]

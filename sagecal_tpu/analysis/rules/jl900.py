@@ -92,7 +92,7 @@ def _words(s: str) -> Set[str]:
     if s not in _WORD_CACHE:
         import re
 
-        _WORD_CACHE[s] = set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", s))
-        if len(_WORD_CACHE) > 2048:
+        if len(_WORD_CACHE) >= 2048:
             _WORD_CACHE.clear()
+        _WORD_CACHE[s] = set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", s))
     return _WORD_CACHE[s]

@@ -107,6 +107,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--fused", action="store_true",
                     help="route the joint-LBFGS cost through the fused "
                          "Pallas RIME kernel (f32 runs only)")
+    ap.add_argument("--coh-dtype", choices=("f32", "bf16"), default="f32",
+                    help="coherency-stack storage dtype on the fused "
+                         "path: bf16 halves the dominant HBM stream "
+                         "(f32 accumulation, ~3 significant digits of "
+                         "coherency precision); quality-watchdog events "
+                         "record the active dtype.  Requires --fused "
+                         "--f32")
     ap.add_argument("--f32", action="store_true",
                     help="solve in float32 (TPU-native precision)")
     ap.add_argument("-V", "--verbose", action="store_true")
@@ -225,6 +232,7 @@ def config_from_args(args) -> RunConfig:
         verbose=args.verbose,
         influence=args.influence,
         use_fused_predict=args.fused,
+        coh_dtype=args.coh_dtype,
         abort_on_divergence=args.abort_on_divergence,
         resume=args.resume,
         checkpoint_every=args.checkpoint_every,
@@ -236,6 +244,10 @@ def _warn_dropped_fused(args, log=print):
     if args.fused and not args.f32:
         log("warning: --fused requires --f32 (the Pallas kernel computes "
             "in float32); the fused path is DISABLED for this f64 run")
+    if getattr(args, "coh_dtype", "f32") == "bf16" and not (
+            args.fused and args.f32):
+        log("warning: --coh-dtype bf16 only applies to the fused f32 "
+            "path (--fused --f32); coherencies stay at the run precision")
 
 
 def main(argv=None):

@@ -58,6 +58,11 @@ class RunConfig:
     per_channel: bool = False
     # joint-LBFGS cost through the fused Pallas RIME kernel (f32 only)
     use_fused_predict: bool = False
+    # coherency-stack storage dtype on the fused path: "f32" (default)
+    # or "bf16" (halved HBM stream, f32 accumulation — ~3 significant
+    # digits of coherency precision; the quality watchdog validates the
+    # solves it produces and its events carry the active coh_dtype)
+    coh_dtype: str = "f32"
     # per-cluster ADMM rho / spatial alpha file (-G, read_arho_fromfile)
     rho_file: Optional[str] = None
     # partial reruns: skip first K tiles, process at most T tiles

@@ -169,6 +169,10 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
         solver_mode=cfg.solver_mode,
         nulow=cfg.nulow, nuhigh=cfg.nuhigh, randomize=cfg.randomize,
         use_fused_predict=cfg.use_fused_predict and not cfg.use_f64,
+        # bf16 coherency storage only exists on the fused f32 path; the
+        # quality watchdog below validates the solves it produces
+        coh_dtype=(cfg.coh_dtype
+                   if cfg.use_fused_predict and not cfg.use_f64 else "f32"),
         collect_telemetry=telemetry_enabled(),
         # quality side outputs feed the watchdog: needed whenever
         # telemetry records them OR the run must be able to abort
@@ -178,7 +182,7 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
         kernel_path="fused" if scfg.use_fused_predict else "xla",
         app="fullbatch", dataset=cfg.dataset, solver_mode=cfg.solver_mode,
         tilesz=cfg.tilesz, n_clusters=M, n_stations=N,
-        simulation_mode=cfg.simulation_mode,
+        simulation_mode=cfg.simulation_mode, coh_dtype=scfg.coh_dtype,
     )
     elog = default_event_log(manifest=manifest)
     # crash forensics + tracing: excepthook/SIGTERM flush the event log
@@ -461,8 +465,11 @@ def _run_fullbatch_host(cfg: RunConfig, log, accel):
 
         q_verdict, q_reasons = "ok", []
         if out.quality is not None:
+            # coh_dtype rides on every quality event so a degraded bf16
+            # run is attributable to the precision knob at a glance
             q_verdict, q_reasons = check_and_emit(
                 elog, out.quality, log=log, tile=t0, app="fullbatch",
+                coh_dtype=scfg.coh_dtype,
             )
         if diverged:
             if q_verdict != "diverged" and elog is not None:

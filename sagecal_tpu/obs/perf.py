@@ -670,17 +670,18 @@ GATE_HIGHER_BETTER = (
     "value", "vs_baseline", "vs_reference_cpu",
     "analytic_tflops_per_sec", "analytic_hbm_gb_per_sec",
     "mfu_vs_v5e_bf16_peak", "bw_util_vs_v5e_819gbps",
-    "warm_start_speedup",
+    "warm_start_speedup", "coh_bf16_iters_per_sec",
 )
 GATE_LOWER_BETTER = (
     "xla_cost_analysis_bytes_accessed", "peak_device_memory_bytes",
-    "compile_seconds_total",
+    "compile_seconds_total", "coh_bf16_xla_cost_analysis_bytes_accessed",
 )
 # the metrics gated when present in BOTH records (others opt in via
 # --metric name=tol)
 GATE_DEFAULT_METRICS = (
     "value", "xla_cost_analysis_bytes_accessed", "peak_device_memory_bytes",
-    "warm_start_speedup",
+    "warm_start_speedup", "coh_bf16_iters_per_sec",
+    "coh_bf16_xla_cost_analysis_bytes_accessed",
 )
 GATE_DEFAULT_TOLERANCE = 0.10
 

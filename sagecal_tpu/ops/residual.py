@@ -128,6 +128,58 @@ def simulate_visibilities(
     return model
 
 
+def fused_objective(
+    data: VisData,
+    cdata: ClusterData,
+    p: jax.Array,
+    nu: Optional[jax.Array] = None,
+    tile: Optional[int] = None,
+    max_rows: Optional[int] = None,
+) -> jax.Array:
+    """Scalar calibration objective through the fused objective kernel
+    (ops/rime_kernel.py): ``sum |(vis - model) * mask|^2`` when ``nu``
+    is None (Gaussian), ``sum log1p(|...|^2 / nu)`` otherwise
+    (Student's-t).  Production entry for eager callers (diagnostics,
+    quality reports, solver harnesses): predict, residual, weighting and
+    reduction happen in ONE pass over the coherency stack — the model
+    and residual never round-trip HBM.  Differentiable w.r.t. ``p``.
+
+    ``p``: (M, nchunk, 8N) real solver parameters.  f32 data only (the
+    kernel computes in float32).
+    """
+    from sagecal_tpu.ops.rime_kernel import (
+        FULL_CLUSTER_TILE, MAX_GRID_ROWS, fused_cost_packed_chunked,
+        fused_cost_packed_hybrid_chunked, pack_gain_tables,
+        pack_predict_inputs, pad_to,
+    )
+
+    if jnp.real(data.vis).dtype != jnp.float32:
+        raise ValueError(
+            "fused_objective requires float32 data (the Pallas kernel "
+            "computes in f32); use the XLA predict path for f64"
+        )
+    M = cdata.coh.shape[0]
+    nchunk = p.shape[1]
+    mp = pad_to(M, 8)
+    tile = FULL_CLUSTER_TILE if tile is None else tile
+    max_rows = MAX_GRID_ROWS if max_rows is None else max_rows
+    vis_ri, mask_p, coh_ri, antp, antq, cmap = pack_predict_inputs(
+        data.vis, data.mask, cdata.coh, data.ant_p, data.ant_q,
+        cdata.chunk_map if nchunk > 1 else None, tile, max_rows=max_rows,
+    )
+    jones = params_to_jones(p.astype(jnp.float32))  # (M, nchunk, N, 2, 2)
+    if nchunk > 1:
+        tre, tim = pack_gain_tables(jones, mp)
+        return fused_cost_packed_hybrid_chunked(
+            tre, tim, coh_ri, antp, antq, vis_ri, mask_p, cmap, nchunk,
+            nu, tile, max_rows,
+        )
+    tre, tim = pack_gain_tables(jones[:, 0], mp)
+    return fused_cost_packed_chunked(
+        tre, tim, coh_ri, antp, antq, vis_ri, mask_p, nu, tile, max_rows,
+    )
+
+
 def residual_norm(res: jax.Array, mask: jax.Array) -> jax.Array:
     """||res||/n_real, the per-tile print (fullbatch_mode.cpp:636-643).
     Delegates to the solver's bookkeeping so the two stay identical.

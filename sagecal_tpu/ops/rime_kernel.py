@@ -33,6 +33,12 @@ with ``jax.custom_vjp``.  Gradients flow to the gain tables only: the
 solver never differentiates w.r.t. coherencies (per-tile constants, like
 the reference's precalculated ``coh`` array).
 
+On top of the predict, :func:`fused_cost_packed` fuses the ENTIRE
+objective — predict, masked residual, Student's-t (or Gaussian)
+weighting, and the scalar reduction — into the same single pass, so a
+``value_and_grad`` never streams a model-sized buffer to or from HBM
+(see the "fused objective" section below).
+
 Everything crosses the kernel boundary as REAL arrays (re/im packed on
 a leading axis): the axon TPU runtime cannot transfer complex arrays,
 and packed reals keep every buffer's minor-most axis long (rows), so
@@ -145,11 +151,10 @@ def _chunk_route(dj, mp, T, nc, sels):
     return jnp.concatenate(parts, axis=1).reshape(mp * nc, T)
 
 
-def _rime_products(c_re, c_im, p_re, p_im, q_re, q_im):
-    """V = Jp (C Jq^H) expanded on (Mp, T) components.  Returns the 8
-    packed output planes [reXX..reYY, imXX..imYY] BEFORE the cluster
-    reduction."""
-    # A = C Jq^H: A_aj = sum_b C_ab conj(Jq_jb); 2x2 index ab = 2a+b.
+def _cjqh(c_re, c_im, q_re, q_im):
+    """A = C Jq^H on (Mp, T) components: A_aj = sum_b C_ab conj(Jq_jb);
+    2x2 index ab = 2a+b.  Shared by the forward products and by the
+    backward pass (which caches A for the cotangent contractions)."""
     a_re, a_im = {}, {}
     for a in range(2):
         for j in range(2):
@@ -160,7 +165,12 @@ def _rime_products(c_re, c_im, p_re, p_im, q_re, q_im):
                 re = re + cr * qr + ci * qi
                 im = im + ci * qr - cr * qi
             a_re[a, j], a_im[a, j] = re, im
-    # V_ij = sum_a Jp_ia A_aj.
+    return a_re, a_im
+
+
+def _jp_a(p_re, p_im, a_re, a_im):
+    """V = Jp A: V_ij = sum_a Jp_ia A_aj.  Returns the 8 packed planes
+    [reXX..reYY, imXX..imYY] BEFORE the cluster reduction."""
     v_re, v_im = [None] * 4, [None] * 4
     for i in range(2):
         for j in range(2):
@@ -172,6 +182,12 @@ def _rime_products(c_re, c_im, p_re, p_im, q_re, q_im):
                 im = im + pr * ai + pi * ar
             v_re[2 * i + j], v_im[2 * i + j] = re, im
     return v_re, v_im
+
+
+def _rime_products(c_re, c_im, p_re, p_im, q_re, q_im):
+    """V = Jp (C Jq^H) expanded on (Mp, T) components."""
+    a_re, a_im = _cjqh(c_re, c_im, q_re, q_im)
+    return _jp_a(p_re, p_im, a_re, a_im)
 
 
 def _onehots(antp_ref, antq_ref, T):
@@ -275,9 +291,24 @@ def _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, *, tile,
 # ---------------------------------------------------------------- backward
 
 
-def _bwd_accumulate(coh_ref, g_ref, p_re, p_im, q_re, q_im, F, MP, T):
+def _g_from_ref(g_ref):
+    """Predict-kernel cotangent source: the upstream model cotangent is
+    an HBM buffer streamed in per grid step; read frequency f's 4 re +
+    4 im (1, T) planes."""
+    def g_of(f, c_re, c_im, a_re, a_im):
+        del c_re, c_im, a_re, a_im
+        return ([g_ref[f, k:k + 1, :] for k in range(4)],
+                [g_ref[f, 4 + k:5 + k, :] for k in range(4)])
+    return g_of
+
+
+def _bwd_accumulate(coh_ref, g_of, p_re, p_im, q_re, q_im, F, MP, T):
     """Per-row gain cotangents dJp/dJq (4 x (MP, T) re/im each),
-    accumulated over freq from the upstream model cotangent g."""
+    accumulated over freq.  ``g_of(f, c_re, c_im, a_re, a_im)`` supplies
+    frequency f's model cotangent as 4 re + 4 im (1, T) planes — either
+    read from an HBM cotangent buffer (predict kernel, :func:`_g_from_
+    ref`) or formed in-register from the residual (objective kernel,
+    which never materializes the model or residual in HBM)."""
     djp_re = [jnp.zeros((MP, T), jnp.float32) for _ in range(4)]
     djp_im = [jnp.zeros((MP, T), jnp.float32) for _ in range(4)]
     djq_re = [jnp.zeros((MP, T), jnp.float32) for _ in range(4)]
@@ -285,20 +316,8 @@ def _bwd_accumulate(coh_ref, g_ref, p_re, p_im, q_re, q_im, F, MP, T):
 
     for f in range(F):
         c_re, c_im = _load_coh_planes(coh_ref, f)
-        g_re = [g_ref[f, k:k + 1, :] for k in range(4)]  # (1, T)
-        g_im = [g_ref[f, 4 + k:5 + k, :] for k in range(4)]
-
-        # Recompute A = C Jq^H.
-        a_re, a_im = {}, {}
-        for a in range(2):
-            for j in range(2):
-                re = im = 0.0
-                for b in range(2):
-                    cr, ci = c_re[2 * a + b], c_im[2 * a + b]
-                    qr, qi = q_re[2 * j + b], q_im[2 * j + b]
-                    re = re + cr * qr + ci * qi
-                    im = im + ci * qr - cr * qi
-                a_re[a, j], a_im[a, j] = re, im
+        a_re, a_im = _cjqh(c_re, c_im, q_re, q_im)  # reused by g_of
+        g_re, g_im = g_of(f, c_re, c_im, a_re, a_im)
 
         # dJp_ia += sum_j g_ij * conj(A_aj)
         for i in range(2):
@@ -368,8 +387,8 @@ def _bwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref, g_ref,
     ohp, ohq = _onehots(antp_ref, antq_ref, T)
     p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T)
     q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T)
-    djp, djq = _bwd_accumulate(coh_ref, g_ref, p_re, p_im, q_re, q_im,
-                               F, MP, T)
+    djp, djq = _bwd_accumulate(coh_ref, _g_from_ref(g_ref), p_re, p_im,
+                               q_re, q_im, F, MP, T)
     _bwd_store(dtabre_ref, dtabim_ref, djp, djq, ohp, ohq, MP, T)
 
 
@@ -380,8 +399,8 @@ def _bwd_kernel_hybrid(antp_ref, antq_ref, cmap_ref, tabre_ref, tabim_ref,
     cmap = cmap_ref[:]
     p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T, NC, cmap)
     q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T, NC, cmap)
-    djp, djq = _bwd_accumulate(coh_ref, g_ref, p_re, p_im, q_re, q_im,
-                               F, MP, T)
+    djp, djq = _bwd_accumulate(coh_ref, _g_from_ref(g_ref), p_re, p_im,
+                               q_re, q_im, F, MP, T)
     _bwd_store(dtabre_ref, dtabim_ref, djp, djq, ohp, ohq, MP, T, NC, cmap)
 
 
@@ -487,7 +506,11 @@ fused_predict_packed_hybrid.defvjp(_vjp_fwd_h, _vjp_bwd_h)
 # tile <= 256 (512 -> 20.9 MB FAILS, 256 -> ~10.5 MB ok) and the
 # BACKWARD — which carries 16 (Mp, T) cotangent accumulators — needs
 # tile <= 128 (256 -> 19.7 MB FAILS).  128 is the safe production tile
-# for any differentiated path at full cluster count.  Large row counts
+# for any differentiated path at full cluster count.  The OBJECTIVE
+# kernels below add only (F, 8, tile) vis + (F, tile) mask blocks and a
+# (1, tile) accumulator on top of the predict footprint (~80 KB at
+# F=2, tile=128 — noise next to the 16 (Mp, T) cotangent accumulators),
+# so the same tile bounds hold.  Large row counts
 # are CHUNKED at the XLA level (lax.map) to keep each Mosaic grid
 # short; NOTE the dominant "compile time" observed for big closures was
 # actually the axon AOT relay ingesting closure constants at ~2 MB/s —
@@ -603,6 +626,382 @@ def chunked_rowsp(rows: int, tile: int = FULL_CLUSTER_TILE,
     return pad_to(-(-rowsp // n), tile) * n
 
 
+# ---------------------------------------------------- fused objective
+#
+# One grid pass that streams each coherency block through VMEM once and
+# emits per-tile PARTIAL COSTS directly: predict Jp C Jq^H, residual
+# (vis - model) * mask, Student's-t weighting log1p(e^2 / nu) (Gaussian
+# e^2 as the nu -> inf degenerate case), reduced on-chip into a
+# revisited (1, tile) accumulator block.  Compared with the predict
+# kernel + XLA cost, this removes TWO buffer-scale HBM streams per
+# value_and_grad: the forward never writes model_ri and the backward
+# re-forms the residual cotangent in-register instead of reading a
+# model-sized upstream cotangent buffer.  nu crosses the boundary as a
+# (1, 1) f32 SMEM scalar so a traced nu (the EM's mean_nu) does not
+# recompile the kernel; ``robust`` is static (Gaussian skips the
+# transcendental entirely).
+
+
+def _vis_spec(F, tile):
+    return pl.BlockSpec((F, 8, tile), lambda r: (0, 0, r),
+                        memory_space=pltpu.VMEM)
+
+
+def _mask_spec(F, tile):
+    return pl.BlockSpec((F, tile), lambda r: (0, r),
+                        memory_space=pltpu.VMEM)
+
+
+def _nu_spec():
+    return pl.BlockSpec((1, 1), lambda r: (0, 0), memory_space=pltpu.SMEM)
+
+
+def _residual_planes(vis_ref, mask_ref, f, v_re, v_im):
+    """Masked residual d = (vis - sum_m V) * mask for frequency f as
+    4 complex-component (d_re, d_im) (1, T) plane pairs, formed from
+    the per-cluster products without ever storing the model."""
+    m = mask_ref[f:f + 1, :]
+    out = []
+    for k in range(4):
+        d_re = (vis_ref[f, k:k + 1, :]
+                - jnp.sum(v_re[k], axis=0, keepdims=True)) * m
+        d_im = (vis_ref[f, 4 + k:5 + k, :]
+                - jnp.sum(v_im[k], axis=0, keepdims=True)) * m
+        out.append((d_re, d_im))
+    return m, out
+
+
+def _obj_partial(coh_ref, vis_ref, mask_ref, nu, robust,
+                 p_re, p_im, q_re, q_im, F, T):
+    """Per-lane partial cost (1, T) for one row tile: sum over freq and
+    complex components of e2 (Gaussian) or log1p(e2/nu) (robust), with
+    e2 the squared masked residual.  Padded rows/clusters carry zero
+    mask/coherency, so they contribute exactly 0."""
+    part = jnp.zeros((1, T), jnp.float32)
+    for f in range(F):
+        c_re, c_im = _load_coh_planes(coh_ref, f)
+        v_re, v_im = _rime_products(c_re, c_im, p_re, p_im, q_re, q_im)
+        _, d = _residual_planes(vis_ref, mask_ref, f, v_re, v_im)
+        for k in range(4):
+            d_re, d_im = d[k]
+            e2 = d_re * d_re + d_im * d_im
+            part = part + (jnp.log1p(e2 / nu) if robust else e2)
+    return part
+
+
+def _obj_store(cost_ref, part):
+    r = pl.program_id(0)
+
+    @pl.when(r == 0)
+    def _init():
+        cost_ref[:, :] = part
+
+    @pl.when(r != 0)
+    def _acc():
+        cost_ref[:, :] = cost_ref[:, :] + part
+
+
+def _obj_fwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref,
+                    vis_ref, mask_ref, nu_ref, cost_ref, *, F, MP, T,
+                    robust):
+    ohp, ohq = _onehots(antp_ref, antq_ref, T)
+    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T)
+    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T)
+    part = _obj_partial(coh_ref, vis_ref, mask_ref, nu_ref[0, 0], robust,
+                        p_re, p_im, q_re, q_im, F, T)
+    _obj_store(cost_ref, part)
+
+
+def _obj_fwd_kernel_hybrid(antp_ref, antq_ref, cmap_ref, tabre_ref,
+                           tabim_ref, coh_ref, vis_ref, mask_ref, nu_ref,
+                           cost_ref, *, F, MP, T, NC, robust):
+    ohp, ohq = _onehots(antp_ref, antq_ref, T)
+    cmap = cmap_ref[:]
+    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T, NC, cmap)
+    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T, NC, cmap)
+    part = _obj_partial(coh_ref, vis_ref, mask_ref, nu_ref[0, 0], robust,
+                        p_re, p_im, q_re, q_im, F, T)
+    _obj_store(cost_ref, part)
+
+
+def _g_from_residual(vis_ref, mask_ref, nu, robust, p_re, p_im):
+    """Objective-kernel cotangent source: re-form the model from the
+    cached A = C Jq^H (no HBM traffic), take the residual, and emit the
+    model cotangent of the scalar cost in-register:
+      g = -2 * mask * d              (Gaussian,  d(e2)/d(model))
+      g = -2 * mask * d / (nu + e2)  (robust, d(log1p(e2/nu))/d(model))
+    The upstream scalar cost cotangent is applied OUTSIDE the kernel."""
+    def g_of(f, c_re, c_im, a_re, a_im):
+        del c_re, c_im
+        v_re, v_im = _jp_a(p_re, p_im, a_re, a_im)
+        m, d = _residual_planes(vis_ref, mask_ref, f, v_re, v_im)
+        g_re, g_im = [], []
+        for k in range(4):
+            d_re, d_im = d[k]
+            if robust:
+                w = 2.0 / (nu + d_re * d_re + d_im * d_im)
+            else:
+                w = 2.0
+            g_re.append(-w * m * d_re)
+            g_im.append(-w * m * d_im)
+        return g_re, g_im
+    return g_of
+
+
+def _obj_bwd_kernel(antp_ref, antq_ref, tabre_ref, tabim_ref, coh_ref,
+                    vis_ref, mask_ref, nu_ref, dtabre_ref, dtabim_ref,
+                    *, F, MP, T, robust):
+    ohp, ohq = _onehots(antp_ref, antq_ref, T)
+    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T)
+    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T)
+    g_of = _g_from_residual(vis_ref, mask_ref, nu_ref[0, 0], robust,
+                            p_re, p_im)
+    djp, djq = _bwd_accumulate(coh_ref, g_of, p_re, p_im, q_re, q_im,
+                               F, MP, T)
+    _bwd_store(dtabre_ref, dtabim_ref, djp, djq, ohp, ohq, MP, T)
+
+
+def _obj_bwd_kernel_hybrid(antp_ref, antq_ref, cmap_ref, tabre_ref,
+                           tabim_ref, coh_ref, vis_ref, mask_ref, nu_ref,
+                           dtabre_ref, dtabim_ref, *, F, MP, T, NC, robust):
+    ohp, ohq = _onehots(antp_ref, antq_ref, T)
+    cmap = cmap_ref[:]
+    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, MP, T, NC, cmap)
+    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, MP, T, NC, cmap)
+    g_of = _g_from_residual(vis_ref, mask_ref, nu_ref[0, 0], robust,
+                            p_re, p_im)
+    djp, djq = _bwd_accumulate(coh_ref, g_of, p_re, p_im, q_re, q_im,
+                               F, MP, T)
+    _bwd_store(dtabre_ref, dtabim_ref, djp, djq, ohp, ohq, MP, T, NC, cmap)
+
+
+def _fused_cost_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri,
+                         mask_p, nu_arr, *, robust, tile, nc=1, cmap=None):
+    Mp, F, rowsp, R = _shape_args(tab_re, coh_ri, tile, nc)
+    assert vis_ri.shape == (F, 8, rowsp) and mask_p.shape == (F, rowsp)
+    if nc == 1:
+        kernel = functools.partial(_obj_fwd_kernel, F=F, MP=Mp, T=tile,
+                                   robust=robust)
+        specs = [_row_spec(tile), _row_spec(tile),
+                 _tab_spec(Mp), _tab_spec(Mp), _coh_spec(Mp, F, tile),
+                 _vis_spec(F, tile), _mask_spec(F, tile), _nu_spec()]
+        args = (ant_p, ant_q, tab_re, tab_im, coh_ri, vis_ri, mask_p,
+                nu_arr)
+    else:
+        kernel = functools.partial(_obj_fwd_kernel_hybrid, F=F, MP=Mp,
+                                   T=tile, NC=nc, robust=robust)
+        specs = [_row_spec(tile), _row_spec(tile), _cmap_spec(Mp, tile),
+                 _tab_spec(Mp * nc), _tab_spec(Mp * nc),
+                 _coh_spec(Mp, F, tile),
+                 _vis_spec(F, tile), _mask_spec(F, tile), _nu_spec()]
+        args = (ant_p, ant_q, cmap, tab_re, tab_im, coh_ri, vis_ri,
+                mask_p, nu_arr)
+    part = pl.pallas_call(
+        kernel,
+        grid=(R,),
+        in_specs=specs,
+        out_specs=pl.BlockSpec((1, tile), lambda r: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, tile), jnp.float32),
+        interpret=_use_interpret(),
+    )(*args)
+    # final lane reduction of the (1, tile) accumulator happens in XLA:
+    # tile floats, not a buffer-scale stream
+    return jnp.sum(part)
+
+
+def _fused_cost_bwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri,
+                         mask_p, nu_arr, *, robust, tile, nc=1, cmap=None):
+    Mp, F, rowsp, R = _shape_args(tab_re, coh_ri, tile, nc)
+    mrows = Mp * nc
+    if nc == 1:
+        kernel = functools.partial(_obj_bwd_kernel, F=F, MP=Mp, T=tile,
+                                   robust=robust)
+        specs = [_row_spec(tile), _row_spec(tile),
+                 _tab_spec(Mp), _tab_spec(Mp), _coh_spec(Mp, F, tile),
+                 _vis_spec(F, tile), _mask_spec(F, tile), _nu_spec()]
+        args = (ant_p, ant_q, tab_re, tab_im, coh_ri, vis_ri, mask_p,
+                nu_arr)
+    else:
+        kernel = functools.partial(_obj_bwd_kernel_hybrid, F=F, MP=Mp,
+                                   T=tile, NC=nc, robust=robust)
+        specs = [_row_spec(tile), _row_spec(tile), _cmap_spec(Mp, tile),
+                 _tab_spec(Mp * nc), _tab_spec(Mp * nc),
+                 _coh_spec(Mp, F, tile),
+                 _vis_spec(F, tile), _mask_spec(F, tile), _nu_spec()]
+        args = (ant_p, ant_q, cmap, tab_re, tab_im, coh_ri, vis_ri,
+                mask_p, nu_arr)
+    return pl.pallas_call(
+        kernel,
+        grid=(R,),
+        in_specs=specs,
+        out_specs=[_tab_spec(mrows), _tab_spec(mrows)],
+        out_shape=[
+            jax.ShapeDtypeStruct((4, mrows, NPAD), jnp.float32),
+            jax.ShapeDtypeStruct((4, mrows, NPAD), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(*args)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9))
+def _fused_cost(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p,
+                nu_arr, robust, tile):
+    return _fused_cost_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
+                                vis_ri, mask_p, nu_arr, robust=robust,
+                                tile=tile)
+
+
+def _cost_vjp_fwd(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p,
+                  nu_arr, robust, tile):
+    out = _fused_cost_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
+                               vis_ri, mask_p, nu_arr, robust=robust,
+                               tile=tile)
+    return out, (tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p,
+                 nu_arr)
+
+
+def _cost_vjp_bwd(robust, tile, res, gbar):
+    tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p, nu_arr = res
+    dre, dim = _fused_cost_bwd_impl(
+        tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p, nu_arr,
+        robust=robust, tile=tile,
+    )
+    # the kernel emits d(cost)/d(tab); scale by the upstream scalar
+    # cotangent here (one scalar-times-table op, not a kernel input)
+    return (gbar * dre, gbar * dim, None, None, None, None, None, None)
+
+
+_fused_cost.defvjp(_cost_vjp_fwd, _cost_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+def _fused_cost_hybrid(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri,
+                       mask_p, nu_arr, cmap, nc, robust, tile):
+    return _fused_cost_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
+                                vis_ri, mask_p, nu_arr, robust=robust,
+                                tile=tile, nc=nc, cmap=cmap)
+
+
+def _cost_vjp_fwd_h(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p,
+                    nu_arr, cmap, nc, robust, tile):
+    out = _fused_cost_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
+                               vis_ri, mask_p, nu_arr, robust=robust,
+                               tile=tile, nc=nc, cmap=cmap)
+    return out, (tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p,
+                 nu_arr, cmap)
+
+
+def _cost_vjp_bwd_h(nc, robust, tile, res, gbar):
+    (tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p, nu_arr,
+     cmap) = res
+    dre, dim = _fused_cost_bwd_impl(
+        tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p, nu_arr,
+        robust=robust, tile=tile, nc=nc, cmap=cmap,
+    )
+    return (gbar * dre, gbar * dim, None, None, None, None, None, None,
+            None)
+
+
+_fused_cost_hybrid.defvjp(_cost_vjp_fwd_h, _cost_vjp_bwd_h)
+
+
+def _nu_cell(nu):
+    """nu as the kernel's (1, 1) f32 SMEM cell.  ``nu=None`` (Gaussian)
+    passes 1.0, which the kernel never reads (``robust`` is static)."""
+    if nu is None:
+        return jnp.ones((1, 1), jnp.float32)
+    return jnp.asarray(nu, jnp.float32).reshape(1, 1)
+
+
+def fused_cost_packed(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri,
+                      mask_p, nu=None, tile=DEF_TILE):
+    """Scalar calibration objective in one fused pass (section comment
+    above): ``sum log1p(|((vis - Jp C Jq^H) * mask)|^2 / nu)`` when
+    ``nu`` is given (Student's-t / robust), ``sum |...|^2`` when ``nu``
+    is None (Gaussian).  ``nu`` may be a traced scalar (the EM's
+    mean_nu).  Differentiable w.r.t. ``tab_re``/``tab_im`` only, via a
+    backward kernel that never materializes the model or residual in
+    HBM."""
+    robust = nu is not None
+    return _fused_cost(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri,
+                       mask_p, _nu_cell(nu), robust, tile)
+
+
+def fused_cost_packed_hybrid(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri,
+                             mask_p, cmap, nc, nu=None, tile=DEF_TILE):
+    """Hybrid-chunk (nc > 1) objective: tables carry one row block per
+    (cluster, chunk), ``cmap`` (Mp, rowsp) selects each row's chunk."""
+    robust = nu is not None
+    return _fused_cost_hybrid(tab_re, tab_im, coh_ri, ant_p, ant_q,
+                              vis_ri, mask_p, _nu_cell(nu), cmap, nc,
+                              robust, tile)
+
+
+def fused_cost_packed_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
+                              vis_ri, mask_p, nu=None,
+                              tile=FULL_CLUSTER_TILE,
+                              max_rows=MAX_GRID_ROWS):
+    """Fused objective for row counts too long for one Mosaic grid:
+    per-row arrays are sliced into equal tile-aligned chunks (see
+    fused_predict_packed_chunked) and the per-chunk scalar costs summed.
+    vis/mask/coh are constants of the solve (stop_gradient, matching
+    the predict wrappers)."""
+    _, F, _, rowsp = coh_ri.shape
+    plan = _chunk_plan(rowsp, tile, max_rows)
+    nu_arr = _nu_cell(nu)
+    robust = nu is not None
+    if plan is None:
+        return _fused_cost(tab_re, tab_im, jax.lax.stop_gradient(coh_ri),
+                           ant_p, ant_q, jax.lax.stop_gradient(vis_ri),
+                           jax.lax.stop_gradient(mask_p), nu_arr, robust,
+                           tile)
+    n, chunk = plan
+
+    def one(i):
+        c = jax.lax.dynamic_slice_in_dim(coh_ri, i * chunk, chunk, axis=3)
+        p = jax.lax.dynamic_slice_in_dim(ant_p, i * chunk, chunk, axis=1)
+        q = jax.lax.dynamic_slice_in_dim(ant_q, i * chunk, chunk, axis=1)
+        v = jax.lax.dynamic_slice_in_dim(vis_ri, i * chunk, chunk, axis=2)
+        m = jax.lax.dynamic_slice_in_dim(mask_p, i * chunk, chunk, axis=1)
+        return _fused_cost(tab_re, tab_im, jax.lax.stop_gradient(c), p, q,
+                           jax.lax.stop_gradient(v),
+                           jax.lax.stop_gradient(m), nu_arr, robust, tile)
+
+    return jnp.sum(jax.lax.map(one, jnp.arange(n)))
+
+
+def fused_cost_packed_hybrid_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
+                                     vis_ri, mask_p, cmap, nc, nu=None,
+                                     tile=FULL_CLUSTER_TILE,
+                                     max_rows=MAX_GRID_ROWS):
+    """Hybrid-chunk (nc > 1) analog of fused_cost_packed_chunked."""
+    _, F, _, rowsp = coh_ri.shape
+    plan = _chunk_plan(rowsp, tile, max_rows)
+    nu_arr = _nu_cell(nu)
+    robust = nu is not None
+    if plan is None:
+        return _fused_cost_hybrid(
+            tab_re, tab_im, jax.lax.stop_gradient(coh_ri), ant_p, ant_q,
+            jax.lax.stop_gradient(vis_ri), jax.lax.stop_gradient(mask_p),
+            nu_arr, cmap, nc, robust, tile)
+    n, chunk = plan
+
+    def one(i):
+        c = jax.lax.dynamic_slice_in_dim(coh_ri, i * chunk, chunk, axis=3)
+        p = jax.lax.dynamic_slice_in_dim(ant_p, i * chunk, chunk, axis=1)
+        q = jax.lax.dynamic_slice_in_dim(ant_q, i * chunk, chunk, axis=1)
+        v = jax.lax.dynamic_slice_in_dim(vis_ri, i * chunk, chunk, axis=2)
+        m = jax.lax.dynamic_slice_in_dim(mask_p, i * chunk, chunk, axis=1)
+        cm = jax.lax.dynamic_slice_in_dim(cmap, i * chunk, chunk, axis=1)
+        return _fused_cost_hybrid(
+            tab_re, tab_im, jax.lax.stop_gradient(c), p, q,
+            jax.lax.stop_gradient(v), jax.lax.stop_gradient(m), nu_arr,
+            cm, nc, robust, tile)
+
+    return jnp.sum(jax.lax.map(one, jnp.arange(n)))
+
+
 # --------------------------------------------------- packing conveniences
 
 
@@ -682,4 +1081,8 @@ from sagecal_tpu.obs.perf import instrumented_jit  # noqa: E402
 
 fused_predict_packed_chunked_jit = instrumented_jit(
     fused_predict_packed_chunked, name="fused_predict_packed_chunked",
+    static_argnames=("tile", "max_rows"))
+
+fused_cost_packed_chunked_jit = instrumented_jit(
+    fused_cost_packed_chunked, name="fused_cost_packed_chunked",
     static_argnames=("tile", "max_rows"))

@@ -336,7 +336,14 @@ def lbfgs_fit(
 # new signature), as are the compile-time loop bounds
 from sagecal_tpu.obs.perf import instrumented_jit  # noqa: E402
 
+# The iteration carry — start params and (for minibatch resumes) the
+# LBFGS memory — is DONATED: both are consumed, never reused by any
+# caller, and at production size p0 alone is ~M*8N floats per tile, so
+# the donation saves one carry-size HBM copy per dispatch (jaxlint
+# JL007 pins this convention).  Callers must not touch the donated
+# buffers after the call; pass a fresh/host array per solve.
 lbfgs_fit_jit = instrumented_jit(
     lbfgs_fit, name="lbfgs_fit",
+    donate_argnames=("p0", "memory"),
     static_argnames=("cost_fn", "grad_fn", "itmax", "M", "minibatch",
                      "collect_trace", "vg_fn"))

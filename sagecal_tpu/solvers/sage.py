@@ -83,6 +83,13 @@ class SageConfig:
     # evaluation vs the XLA predict's multiple buffer-scale
     # intermediates.  f32 data only.
     use_fused_predict: bool = struct.field(pytree_node=False, default=False)
+    # Coherency-stack storage dtype on the fused path: "f32" (default)
+    # or "bf16" (halves the dominant HBM stream; the kernel upcasts at
+    # the VMEM load and accumulates in f32 — ~3 significant digits of
+    # coherency precision, a throughput knob validated by the quality
+    # watchdog, NOT for the final 1e-6-bar solve).  Ignored on the XLA
+    # path.
+    coh_dtype: str = struct.field(pytree_node=False, default="f32")
     # Static ceiling multiplier for the weighted per-cluster iteration
     # allocation (lmfit.c:859-882): a high-error cluster may be granted up
     # to iter_budget_cap * max_iter iterations by the -R weighting.  The
@@ -439,15 +446,20 @@ def _res_norm(res, mask, nreal):
     return jnp.sqrt(jnp.sum(jnp.abs(r) ** 2)) / nreal
 
 
-def _make_fused_joint_cost(data, cdata, M, nchunk_max, n8, robust, mean_nu):
-    """Joint-LBFGS cost through the fused Pallas RIME kernel
-    (ops/rime_kernel.py) instead of the XLA predict — same math, one
-    pass over the coherency stack per evaluation.  The packed/padded
-    arrays are built ONCE here (they are constants of the LBFGS loop).
-    f32 only: the kernel computes in float32."""
+def _make_fused_joint_cost(data, cdata, M, nchunk_max, n8, robust, mean_nu,
+                           coh_dtype="f32"):
+    """Joint-LBFGS cost through the fused OBJECTIVE kernel
+    (ops/rime_kernel.py): predict, masked residual, Student's-t (or
+    Gaussian) weighting and the scalar reduction all happen in ONE pass
+    over the coherency stack — neither the model nor the residual ever
+    round-trips HBM, forward or backward.  The packed/padded arrays are
+    built ONCE here (they are constants of the LBFGS loop).  f32 only:
+    the kernel computes in float32.  ``coh_dtype="bf16"`` stores the
+    coherency stack as bfloat16 (halved HBM stream, f32 accumulation —
+    SageConfig.coh_dtype rationale)."""
     from sagecal_tpu.ops.rime_kernel import (
-        FULL_CLUSTER_TILE, MAX_GRID_ROWS, fused_predict_packed_chunked,
-        fused_predict_packed_hybrid_chunked, pack_gain_tables,
+        FULL_CLUSTER_TILE, MAX_GRID_ROWS, fused_cost_packed_chunked,
+        fused_cost_packed_hybrid_chunked, pack_gain_tables,
         pack_predict_inputs, pad_to,
     )
 
@@ -456,6 +468,9 @@ def _make_fused_joint_cost(data, cdata, M, nchunk_max, n8, robust, mean_nu):
             "use_fused_predict requires float32 data (the Pallas kernel "
             "computes in f32); run with f64 disabled or use the XLA path"
         )
+    if coh_dtype not in ("f32", "bf16"):
+        raise ValueError(f"coh_dtype must be 'f32' or 'bf16', got "
+                         f"{coh_dtype!r}")
     # FULL_CLUSTER_TILE (128) is the largest tile whose BACKWARD kernel
     # fits the v5e 16 MB scoped-VMEM limit at ~100 clusters, and rows
     # are chunked so each Mosaic grid stays short — the hardware-proven
@@ -466,7 +481,10 @@ def _make_fused_joint_cost(data, cdata, M, nchunk_max, n8, robust, mean_nu):
         cdata.chunk_map if nchunk_max > 1 else None, FULL_CLUSTER_TILE,
         max_rows=MAX_GRID_ROWS,
     )
+    if coh_dtype == "bf16":
+        coh_ri = coh_ri.astype(jnp.bfloat16)
     coh_c = jax.lax.stop_gradient(coh_ri)
+    nu_c = mean_nu if robust else None
 
     def cost_fn(pflat):
         jones = params_to_jones(
@@ -474,21 +492,15 @@ def _make_fused_joint_cost(data, cdata, M, nchunk_max, n8, robust, mean_nu):
         )  # (M, nchunk, N, 2, 2)
         if nchunk_max > 1:
             tre, tim = pack_gain_tables(jones, mp)
-            model = fused_predict_packed_hybrid_chunked(
-                tre, tim, coh_c, antp, antq, cmap, nchunk_max,
-                FULL_CLUSTER_TILE, MAX_GRID_ROWS,
+            return fused_cost_packed_hybrid_chunked(
+                tre, tim, coh_c, antp, antq, vis_ri, mask_p, cmap,
+                nchunk_max, nu_c, FULL_CLUSTER_TILE, MAX_GRID_ROWS,
             )
-        else:
-            tre, tim = pack_gain_tables(jones[:, 0], mp)
-            model = fused_predict_packed_chunked(
-                tre, tim, coh_c, antp, antq, FULL_CLUSTER_TILE,
-                MAX_GRID_ROWS,
-            )
-        d = (vis_ri - model) * mask_p[:, None, :]
-        e2 = d[:, :4, :] ** 2 + d[:, 4:, :] ** 2
-        if robust:
-            return jnp.sum(jnp.log1p(e2 / mean_nu))
-        return jnp.sum(e2)
+        tre, tim = pack_gain_tables(jones[:, 0], mp)
+        return fused_cost_packed_chunked(
+            tre, tim, coh_c, antp, antq, vis_ri, mask_p, nu_c,
+            FULL_CLUSTER_TILE, MAX_GRID_ROWS,
+        )
 
     return cost_fn
 
@@ -664,7 +676,8 @@ def sagefit(
 
         if config.use_fused_predict:
             cost_fn = _make_fused_joint_cost(
-                data, cdata, M, nchunk_max, n8, robust, mean_nu
+                data, cdata, M, nchunk_max, n8, robust, mean_nu,
+                config.coh_dtype,
             )
         else:
 
@@ -776,8 +789,11 @@ def sagefit_packed(
 # abstract input signature — a new tile shape or a changed static
 # SageConfig — is visible as a recorded compile with lowering/compile
 # wall-time and cost_analysis() flops/bytes; telemetry off is the plain
-# jax.jit call
-_sagefit_packed_jit = instrumented_jit(sagefit_packed, name="sagefit_packed")
+# jax.jit call.  ``p0`` (the tile's warm-start carry) is DONATED:
+# solve_tile rebuilds it from numpy per call and the apps thread the
+# RESULT p forward, never the input buffer (jaxlint JL007 convention).
+_sagefit_packed_jit = instrumented_jit(sagefit_packed, name="sagefit_packed",
+                                       donate_argnames=("p0",))
 
 
 def solve_tile(

@@ -1,0 +1,30 @@
+"""JL007 must-not-fire fixture: every carry-named jit parameter is
+either donated (argnums or argnames, any wrap form) or declared
+static, and non-carry names never match."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2))
+def fit(p0, data, memory):  # p0 and memory donated by position
+    return p0 + jnp.sum(data) + memory
+
+
+def _step(state, grad):
+    return state - 0.1 * grad
+
+
+step_jit = jax.jit(_step, donate_argnames=("state",))
+
+
+@functools.partial(jax.jit, static_argnames=("carry",))
+def unrolled(carry, x):  # static carry is trace-time, nothing to donate
+    return x + carry
+
+
+@jax.jit
+def predict(params, coords):  # non-carry names: rule does not match
+    return params * jnp.cos(coords)

@@ -1,0 +1,43 @@
+"""JL007 should-fire fixture: jit entries threading carry-named
+parameters (``p0``/``state``/``memory``) without donate_argnums, over
+every wrap form the call graph recognizes."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def fit(p0, data):  # JL007: p0 undonated (decorator form)
+    return p0 + jnp.sum(data)
+
+
+def _step(state, grad):  # JL007: state undonated (call-site wrap)
+    return state - 0.1 * grad
+
+
+step_jit = jax.jit(_step)
+
+
+def _update(memory, delta):  # JL007: memory undonated (partial form)
+    return memory + delta
+
+
+update_jit = functools.partial(jax.jit, _update)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def consume(state, rhs):  # donated by argnum: must NOT fire
+    return state + rhs
+
+
+def _refit(p0, obs):  # donated by argname: must NOT fire
+    return p0 * jnp.mean(obs)
+
+
+refit_jit = jax.jit(_refit, donate_argnames=("p0",))
+
+
+def plain_host(p0):  # not a jit root: must NOT fire
+    return p0

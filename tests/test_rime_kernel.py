@@ -399,3 +399,304 @@ def test_hybrid_chunked_matches_unchunked():
     )(tab_re, tab_im)
     for r, g in zip(g_ref, g_got):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=5e-3)
+
+
+# --------------------------------------------------- fused objective kernel
+
+
+def _cost_problem(seed=0, M=3, N=6, F=2, rows=200, drop=0.15):
+    """Packed problem + visibilities and a mask with random drops (and
+    zeros on every padded row)."""
+    (jones, coh, ant_p, ant_q, coh_ri, antp, antq, mp,
+     rowsp) = _random_problem(seed=seed, M=M, N=N, F=F, rows=rows)
+    rng = np.random.default_rng(seed + 100)
+    vis_ri = np.zeros((F, 8, rowsp), np.float32)
+    vis_ri[:, :, :rows] = rng.standard_normal((F, 8, rows))
+    mask_p = np.zeros((F, rowsp), np.float32)
+    mask_p[:, :rows] = (rng.random((F, rows)) > drop).astype(np.float32)
+    return (jones, coh, ant_p, ant_q, coh_ri, antp, antq, mp, rowsp,
+            vis_ri, mask_p)
+
+
+def _xla_cost(tab_re, tab_im, coh_j, antp_j, antq_j, vis_j, mask_j,
+              M, N, nu):
+    """The solver's XLA cost from the same packed inputs (sage.py
+    joint-cost math: residual -> per-complex-component |.|^2 ->
+    Student's-t log1p or Gaussian sum)."""
+    rowsp = coh_j.shape[-1]
+    tab = (tab_re + 1j * tab_im)[:, :M, :N]
+    jns = jnp.transpose(tab, (1, 2, 0)).reshape(M, N, 2, 2)
+    jp = jns[:, antp_j[0, :]]
+    jq = jns[:, antq_j[0, :]]
+    c = jax.lax.complex(coh_j[:M, :, :4, :], coh_j[:M, :, 4:, :])
+    c = jnp.moveaxis(c, -1, 1).reshape(M, rowsp, c.shape[1], 2, 2)
+    v = jnp.einsum("mria,mrfab,mrjb->frij", jp, c, jq.conj())
+    v = v.reshape(c.shape[2], rowsp, 4).transpose(0, 2, 1)
+    model = jnp.concatenate([jnp.real(v), jnp.imag(v)], axis=1)
+    d = (vis_j - model) * mask_j[:, None, :]
+    e2 = d[:, :4, :] ** 2 + d[:, 4:, :] ** 2
+    if nu is None:
+        return jnp.sum(e2)
+    return jnp.sum(jnp.log1p(e2 / nu))
+
+
+@pytest.mark.parametrize("nu", [None, 5.0], ids=["gaussian", "robust"])
+def test_fused_cost_and_grad_match_xla(nu):
+    """Acceptance bar: fused objective cost AND gain-table gradient
+    within 1e-5 relative of the XLA cost from identical packed inputs
+    (Gaussian and Student's-t nu=5), with masked and padded rows."""
+    from sagecal_tpu.ops.rime_kernel import fused_cost_packed
+
+    (jones, coh, ant_p, ant_q, coh_ri, antp, antq, mp, rowsp,
+     vis_ri, mask_p) = _cost_problem(seed=4)
+    M, N = jones.shape[0], jones.shape[1]
+    tab_re, tab_im = pack_gain_tables(jnp.asarray(jones), mp)
+    coh_j, antp_j, antq_j, vis_j, mask_j = map(
+        jnp.asarray, (coh_ri, antp, antq, vis_ri, mask_p))
+
+    def ck(a, b):
+        return fused_cost_packed(a, b, coh_j, antp_j, antq_j, vis_j,
+                                 mask_j, nu, TILE)
+
+    def cx(a, b):
+        return _xla_cost(a, b, coh_j, antp_j, antq_j, vis_j, mask_j,
+                         M, N, nu)
+
+    vk, gk = jax.value_and_grad(ck, argnums=(0, 1))(tab_re, tab_im)
+    vx, gx = jax.value_and_grad(cx, argnums=(0, 1))(tab_re, tab_im)
+    assert abs(float(vk) - float(vx)) / abs(float(vx)) <= 1e-5
+    for a, b in zip(gk, gx):
+        a, b = np.asarray(a), np.asarray(b)
+        assert np.abs(a - b).max() / np.abs(b).max() <= 1e-5
+        # padded table rows/cols receive zero gradient
+        np.testing.assert_array_equal(a[:, M:, :], 0.0)
+        np.testing.assert_array_equal(a[:, :, N:], 0.0)
+
+
+def test_fused_cost_fully_masked_rows_contribute_zero():
+    """A fully-masked problem costs exactly 0 (robust: log1p(0)=0), and
+    the padded-row tail beyond `rows` never contributes."""
+    from sagecal_tpu.ops.rime_kernel import fused_cost_packed
+
+    (jones, coh, ant_p, ant_q, coh_ri, antp, antq, mp, rowsp,
+     vis_ri, mask_p) = _cost_problem(seed=5)
+    tab_re, tab_im = pack_gain_tables(jnp.asarray(jones), mp)
+    args = tuple(map(jnp.asarray, (coh_ri, antp, antq, vis_ri)))
+    zero_mask = jnp.zeros_like(jnp.asarray(mask_p))
+    for nu in (None, 5.0):
+        c = fused_cost_packed(tab_re, tab_im, *args, zero_mask, nu, TILE)
+        assert float(c) == 0.0
+    # padded tail: replicating garbage visibilities beyond `rows` does
+    # not change the cost (their mask is 0)
+    rows = coh.shape[-1]
+    vis_bad = np.array(vis_ri)
+    vis_bad[:, :, rows:] = 1e6
+    c_ref = fused_cost_packed(tab_re, tab_im, *args, jnp.asarray(mask_p),
+                              5.0, TILE)
+    c_bad = fused_cost_packed(tab_re, tab_im, args[0], args[1], args[2],
+                              jnp.asarray(vis_bad), jnp.asarray(mask_p),
+                              5.0, TILE)
+    assert float(c_ref) == float(c_bad)
+
+
+@pytest.mark.parametrize("rows", [TILE, TILE + 1, 130],
+                         ids=["exact-tile", "tile+1", "short"])
+def test_fused_cost_row_padding_edges(rows):
+    """Mp (cluster) and rowsp (row) padding edges: exact-tile rows,
+    one-over-tile, and short rows all match the XLA cost."""
+    from sagecal_tpu.ops.rime_kernel import fused_cost_packed
+
+    (jones, coh, ant_p, ant_q, coh_ri, antp, antq, mp, rowsp,
+     vis_ri, mask_p) = _cost_problem(seed=6, M=5, rows=rows)
+    M, N = jones.shape[0], jones.shape[1]
+    assert mp == 8 and mp > M  # cluster axis genuinely padded
+    tab_re, tab_im = pack_gain_tables(jnp.asarray(jones), mp)
+    coh_j, antp_j, antq_j, vis_j, mask_j = map(
+        jnp.asarray, (coh_ri, antp, antq, vis_ri, mask_p))
+    ck = fused_cost_packed(tab_re, tab_im, coh_j, antp_j, antq_j,
+                           vis_j, mask_j, 5.0, TILE)
+    cx = _xla_cost(tab_re, tab_im, coh_j, antp_j, antq_j, vis_j, mask_j,
+                   M, N, 5.0)
+    assert abs(float(ck) - float(cx)) / abs(float(cx)) <= 1e-5
+
+
+def test_fused_cost_chunked_matches_unchunked():
+    from sagecal_tpu.ops.rime_kernel import (
+        chunked_rowsp,
+        fused_cost_packed,
+        fused_cost_packed_chunked,
+    )
+
+    max_rows = 4 * TILE
+    rows = 9 * TILE + 37
+    rowsp = chunked_rowsp(rows, TILE, max_rows)
+    (jones, coh, ant_p, ant_q, _, _, _, mp,
+     _) = _random_problem(seed=8, rows=rows)
+    rng = np.random.default_rng(9)
+    F = coh.shape[1]
+    coh_ri = np.zeros((mp, F, 8, rowsp), np.float32)
+    coh_ri[:3, :, :4, :rows] = coh.real
+    coh_ri[:3, :, 4:, :rows] = coh.imag
+    antp = np.zeros((1, rowsp), np.int32)
+    antq = np.zeros((1, rowsp), np.int32)
+    antp[0, :rows] = ant_p
+    antq[0, :rows] = ant_q
+    vis_ri = np.zeros((F, 8, rowsp), np.float32)
+    vis_ri[:, :, :rows] = rng.standard_normal((F, 8, rows))
+    mask_p = np.zeros((F, rowsp), np.float32)
+    mask_p[:, :rows] = 1.0
+    tab_re, tab_im = pack_gain_tables(jnp.asarray(jones), mp)
+    args = tuple(map(jnp.asarray, (coh_ri, antp, antq, vis_ri, mask_p)))
+
+    for nu in (None, 5.0):
+        ref = jax.value_and_grad(
+            lambda a, b: fused_cost_packed(a, b, *args, nu, TILE),
+            argnums=(0, 1))(tab_re, tab_im)
+        got = jax.value_and_grad(
+            lambda a, b: fused_cost_packed_chunked(
+                a, b, *args, nu, TILE, max_rows),
+            argnums=(0, 1))(tab_re, tab_im)
+        np.testing.assert_allclose(float(got[0]), float(ref[0]),
+                                   rtol=1e-6)
+        for r, g in zip(ref[1], got[1]):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_fused_cost_hybrid_matches_xla():
+    """nc>1 objective: per-row chunk gain selection, cost + grads vs
+    the XLA cost with the same cmap-selected gains."""
+    from sagecal_tpu.ops.rime_kernel import fused_cost_packed_hybrid
+
+    rng = np.random.default_rng(12)
+    M, N, F, rows, nc = 3, 6, 2, 200, 3
+    mp = pad_to(M, MC)
+    rowsp = pad_to(rows, TILE)
+    jones = rng.standard_normal((M, nc, N, 2, 2)) + 1j * rng.standard_normal(
+        (M, nc, N, 2, 2))
+    coh = rng.standard_normal((M, F, 4, rows)) + 1j * rng.standard_normal(
+        (M, F, 4, rows))
+    ant_p = rng.integers(0, N - 1, rows)
+    ant_q = ant_p + rng.integers(1, N - ant_p)
+    cmap_full = rng.integers(0, nc, (M, rows)).astype(np.int32)
+    coh_ri = np.zeros((mp, F, 8, rowsp), np.float32)
+    coh_ri[:M, :, :4, :rows] = coh.real
+    coh_ri[:M, :, 4:, :rows] = coh.imag
+    antp = np.zeros((1, rowsp), np.int32)
+    antq = np.zeros((1, rowsp), np.int32)
+    antp[0, :rows] = ant_p
+    antq[0, :rows] = ant_q
+    cmap = np.zeros((mp, rowsp), np.int32)
+    cmap[:M, :rows] = cmap_full
+    vis_ri = np.zeros((F, 8, rowsp), np.float32)
+    vis_ri[:, :, :rows] = rng.standard_normal((F, 8, rows))
+    mask_p = np.zeros((F, rowsp), np.float32)
+    mask_p[:, :rows] = 1.0
+
+    tab_re, tab_im = pack_gain_tables(jnp.asarray(jones), mp)
+    coh_j, antp_j, antq_j, vis_j, mask_j, cmap_j = map(
+        jnp.asarray, (coh_ri, antp, antq, vis_ri, mask_p, cmap))
+
+    def ck(a, b):
+        return fused_cost_packed_hybrid(a, b, coh_j, antp_j, antq_j,
+                                        vis_j, mask_j, cmap_j, nc, 5.0,
+                                        TILE)
+
+    def cx(a, b):
+        tab = (a + 1j * b)[:, : M * nc, :N].reshape(4, M, nc, N)
+        jns = jnp.transpose(tab, (1, 2, 3, 0)).reshape(M, nc, N, 2, 2)
+        cm = jnp.asarray(cmap_full)
+        jp = jns[jnp.arange(M)[:, None], cm, jnp.asarray(ant_p)[None, :]]
+        jq = jns[jnp.arange(M)[:, None], cm, jnp.asarray(ant_q)[None, :]]
+        cc = jax.lax.complex(coh_j[:M, :, :4, :rows],
+                             coh_j[:M, :, 4:, :rows])
+        cc = jnp.moveaxis(cc, -1, 1).reshape(M, rows, F, 2, 2)
+        vv = jnp.einsum("mria,mrfab,mrjb->frij", jp, cc, jq.conj())
+        vv = vv.reshape(F, rows, 4).transpose(0, 2, 1)
+        model = jnp.concatenate([jnp.real(vv), jnp.imag(vv)], axis=1)
+        model = jnp.pad(model, ((0, 0), (0, 0), (0, rowsp - rows)))
+        d = (vis_j - model) * mask_j[:, None, :]
+        e2 = d[:, :4, :] ** 2 + d[:, 4:, :] ** 2
+        return jnp.sum(jnp.log1p(e2 / 5.0))
+
+    vk, gk = jax.value_and_grad(ck, argnums=(0, 1))(tab_re, tab_im)
+    vx, gx = jax.value_and_grad(cx, argnums=(0, 1))(tab_re, tab_im)
+    assert abs(float(vk) - float(vx)) / abs(float(vx)) <= 1e-5
+    for a, b in zip(gk, gx):
+        a, b = np.asarray(a), np.asarray(b)
+        assert np.abs(a - b).max() / np.abs(b).max() <= 1e-5
+
+
+def test_fused_objective_entry_matches_solver_residual():
+    """ops.residual.fused_objective (the production eager entry) agrees
+    with the XLA predict + residual + robust-sum path on VisData."""
+    from sagecal_tpu.core.types import jones_to_params
+    from sagecal_tpu.io.simulate import (
+        corrupt_and_observe, make_visdata, random_jones,
+    )
+    from sagecal_tpu.ops.residual import fused_objective
+    from sagecal_tpu.ops.rime import point_source_batch
+    from sagecal_tpu.solvers.sage import build_cluster_data, predict_full_model
+
+    f0 = 150e6
+    data = make_visdata(nstations=6, tilesz=2, nchan=1, freq0=f0,
+                        dtype=np.float32, seed=13)
+    clusters = [
+        point_source_batch([0.02], [0.01], [2.0], f0=f0, dtype=jnp.float32),
+        point_source_batch([-0.01], [0.02], [1.5], f0=f0, dtype=jnp.float32),
+    ]
+    jt = random_jones(2, 6, seed=14, amp=0.2, dtype=np.complex64)
+    data = corrupt_and_observe(data, clusters, jones=jt, noise_sigma=0.05,
+                               seed=15)
+    cdata = build_cluster_data(data, clusters, [1, 1], fdelta=0.0)
+    p = jones_to_params(jt)[:, None, :]
+
+    model = predict_full_model(p, cdata, data)
+    d = (data.vis - model) * data.mask
+    e2 = jnp.real(d) ** 2 + jnp.imag(d) ** 2
+    for nu, want in ((None, jnp.sum(e2)),
+                     (5.0, jnp.sum(jnp.log1p(e2 / 5.0)))):
+        got = fused_objective(data, cdata, p, nu=nu)
+        assert (abs(float(got) - float(want)) / abs(float(want))
+                <= 1e-5)
+
+
+def test_donated_lbfgs_entry_bit_identical_and_consumes_input():
+    """lbfgs_fit_jit donates its carry (p0, memory): the solve must be
+    bit-identical to an undonated jit of the same solver, and the
+    donated input buffer must actually be consumed."""
+    from sagecal_tpu.ops.rime_kernel import fused_cost_packed
+    from sagecal_tpu.solvers.lbfgs import lbfgs_fit, lbfgs_fit_jit
+
+    (jones, coh, ant_p, ant_q, coh_ri, antp, antq, mp, rowsp,
+     vis_ri, mask_p) = _cost_problem(seed=21)
+    args = tuple(map(jnp.asarray, (coh_ri, antp, antq, vis_ri, mask_p)))
+    nparam = int(np.prod((4, mp, NPAD)))
+
+    def cost_fn(p):
+        tre = p[:nparam].reshape(4, mp, NPAD)
+        tim = p[nparam:].reshape(4, mp, NPAD)
+        return fused_cost_packed(tre, tim, *args, 5.0, TILE)
+
+    tab_re, tab_im = pack_gain_tables(jnp.asarray(jones), mp)
+    p0_host = np.concatenate(
+        [np.asarray(tab_re).ravel(), np.asarray(tab_im).ravel()])
+
+    plain = jax.jit(
+        lbfgs_fit,
+        static_argnames=("cost_fn", "grad_fn", "itmax", "M", "minibatch",
+                         "collect_trace", "vg_fn"))
+    p_ref = jnp.asarray(p0_host)
+    r_ref = plain(cost_fn, None, p_ref, itmax=5, M=3)
+
+    p_don = jnp.asarray(p0_host)
+    r_don = lbfgs_fit_jit(cost_fn, None, p_don, itmax=5, M=3)
+
+    np.testing.assert_array_equal(np.asarray(r_don.p), np.asarray(r_ref.p))
+    np.testing.assert_array_equal(np.asarray(r_don.cost),
+                                  np.asarray(r_ref.cost))
+    np.testing.assert_array_equal(np.asarray(r_don.memory.s),
+                                  np.asarray(r_ref.memory.s))
+    # the donated buffer is gone; the undonated one survives
+    assert p_don.is_deleted()
+    assert not p_ref.is_deleted()

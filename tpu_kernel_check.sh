@@ -35,6 +35,23 @@ if ! JAX_PLATFORMS=cpu timeout 120 python -m sagecal_tpu.obs.diag lint \
     sagecal_tpu/; then
   echo "LINT GATE FAILED (new jaxlint findings) - stop"; exit 1
 fi
+# fused-OBJECTIVE parity smoke next, still CPU-only: the interpret-mode
+# kernel must match the XLA replica (cost + grad <=1e-5 rel, masked and
+# padded edges) before any TPU time is spent on it
+echo "=== fused-objective CPU parity smoke (interpret vs XLA)"
+JAX_PLATFORMS=cpu timeout 480 python -m pytest tests/test_rime_kernel.py -q \
+  -k "fused_cost or fused_objective or donated" -p no:cacheprovider | tail -3
+rc=${PIPESTATUS[0]}
+if [ "$rc" != 0 ]; then echo "fused parity smoke FAILED rc=$rc - stop"; exit 1; fi
+# AOT HBM-traffic gate (no execution, CPU): the fused objective must
+# stay >=35% under the XLA predict+cost step in cost_analysis bytes
+echo "=== fused-objective AOT bytes gate"
+JAX_PLATFORMS=cpu timeout 480 python tools/bench_fused_bytes.py \
+  --tilesz 2 --min-reduction 0.35 | tail -3
+rc=${PIPESTATUS[0]}
+if [ "$rc" != 0 ]; then
+  echo "AOT BYTES GATE FAILED (fused objective lost its traffic win)"; exit 1
+fi
 step bisect-c 200 python kbisect.py c
 step bisect-b 200 python kbisect.py b
 step bisect-a 200 python kbisect.py a
@@ -131,3 +148,23 @@ from sagecal_tpu.io.solutions import validate_solutions
 v = validate_solutions('$ELDIR/sol.txt')
 assert v['n_intervals'] == 4 and v['torn_rows'] == 0, v
 print('elastic smoke ok:', v)" || { echo "elastic smoke validate FAILED"; exit 1; }
+echo "=== elastic kill-and-resume smoke, fused objective + donation (CPU)"
+# same preemption drill through the FUSED objective path (--fused --f32,
+# interpret-mode kernels on CPU): proves the donated lbfgs carries
+# (p0/memory invalidated after each jitted call) never leak a stale
+# buffer into a checkpoint — resumed run must produce an untorn,
+# complete solution file just like the XLA path above
+ELFUSED=(python -m sagecal_tpu.apps.cli -d "$ELDIR/d.h5" -s "$ELDIR/sky.txt"
+       -p "$ELDIR/sol_fused.txt" -t 2 -e 1 -g 4 -l 6 -j 1
+       --checkpoint-every 1 --fused --f32)
+JAX_PLATFORMS=cpu timeout 300 python -m sagecal_tpu.elastic.faultinject \
+  kill-at-ckpt 1 "$ELDIR/sol_fused.txt.ckpt" -- "${ELFUSED[@]}" \
+  || { echo "fused elastic kill step FAILED"; exit 1; }
+JAX_PLATFORMS=cpu timeout 300 "${ELFUSED[@]}" --resume \
+  || { echo "fused elastic resume FAILED rc=$?"; exit 1; }
+JAX_PLATFORMS=cpu timeout 60 python -c "
+from sagecal_tpu.io.solutions import validate_solutions
+v = validate_solutions('$ELDIR/sol_fused.txt')
+assert v['n_intervals'] == 4 and v['torn_rows'] == 0, v
+print('fused elastic smoke ok:', v)" \
+  || { echo "fused elastic smoke validate FAILED"; exit 1; }
