@@ -476,6 +476,130 @@ def _measure_cpu_subprocess(tilesz=TILESZ, timeout=1800.0):
     return None
 
 
+def run_serve_bench(batch=8, repeats=5, device=None,
+                    nstations=16, tilesz=1, nclusters=2):
+    """Serve-path throughput: ``batch`` independent same-shape solves
+    dispatched as ONE vmapped program (through the serve executable
+    cache) vs the same solves as a sequential ``solve_tile`` loop.
+
+    The default shape (N=16 stations, one timeslot per tile — a
+    single-interval serving request) sits in the regime the
+    multi-tenant batcher exists for: each solve is too small to cover
+    the per-dispatch floor and per-op runtime overhead, so batching
+    amortizes both (measured ~5x on this host's single CPU core; the
+    win collapses to ~1x by N=24 where one solve is compute-bound —
+    the bucketer decides, the bench pins the overhead-bound class).
+    Both sides are timed WARM (compiles excluded) and both include
+    their host-side packing — the sequential loop packs per call, the
+    batched path stacks the whole bucket — so the ratio is the
+    end-to-end serve win, not a kernel-only number.
+
+    Returns a record dict: ``solves_per_sec_per_chip`` (batched,
+    higher-better), ``serve_batch_speedup`` (batched vs sequential
+    throughput, higher-better), ``serve_p50_latency_s`` (median batch
+    dispatch wall time, lower-better) — all gate-able via `diag gate`.
+    """
+    import statistics
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from sagecal_tpu.core.types import jones_to_params
+    from sagecal_tpu.io.simulate import corrupt_and_observe, make_visdata, random_jones
+    from sagecal_tpu.ops.rime import point_source_batch
+    from sagecal_tpu.serve.bucket import bucket_of
+    from sagecal_tpu.serve.cache import ExecutableCache
+    from sagecal_tpu.solvers.sage import SageConfig, build_cluster_data, solve_tile
+
+    # ---- build `batch` distinct small workloads (CPU backend: eager
+    # complex ops are unimplemented on the axon TPU — same constraint
+    # as build_workload)
+    rng = np.random.default_rng(11)
+    f0 = 150e6
+    entries = []
+    with jax.default_device(_cpu_device()):
+        for b in range(batch):
+            data = make_visdata(nstations=nstations, tilesz=tilesz,
+                                nchan=1, freq0=f0, dtype=np.float32)
+            ll = rng.uniform(-0.05, 0.05, nclusters)
+            mm = rng.uniform(-0.05, 0.05, nclusters)
+            flux = rng.uniform(0.5, 5.0, nclusters)
+            clusters = [
+                point_source_batch([ll[k]], [mm[k]], [flux[k]], f0=f0,
+                                   dtype=jnp.float32)
+                for k in range(nclusters)
+            ]
+            jones = random_jones(nclusters, nstations, seed=100 + b,
+                                 amp=0.15, dtype=np.complex64)
+            data = corrupt_and_observe(data, clusters, jones=jones,
+                                       noise_sigma=1e-3)
+            cdata = build_cluster_data(data, clusters, [1] * nclusters)
+            p0 = np.asarray(jones_to_params(
+                random_jones(nclusters, nstations, seed=0, amp=0.0,
+                             dtype=np.complex64))[:, None, :])
+            key = np.asarray(jax.random.PRNGKey(200 + b))
+            entries.append((data, cdata, p0, key))
+
+    cfg = SageConfig(max_emiter=1, max_iter=2, max_lbfgs=4,
+                     solver_mode=1, collect_telemetry=False,
+                     collect_quality=False)
+
+    def run_sequential():
+        t0 = _time.perf_counter()
+        for data, cdata, p0, key in entries:
+            out = solve_tile(data, cdata, p0.copy(), cfg, key=key,
+                             device=device)
+            np.asarray(out.p)  # host materialize = request completion
+        return _time.perf_counter() - t0
+
+    def run_batched(fn):
+        t0 = _time.perf_counter()
+        data_b = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]),
+            *[e[0].replace(vis=None) for e in entries])
+        cdata_b = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]),
+            *[e[1]._replace(coh=None) for e in entries])
+        vis = np.stack([np.asarray(e[0].vis) for e in entries])
+        coh = np.stack([np.asarray(e[1].coh) for e in entries])
+        p0 = np.stack([e[2] for e in entries])
+        keys = np.stack([e[3] for e in entries])
+        args = (data_b, cdata_b, vis.real, vis.imag, coh.real, coh.imag,
+                p0, cfg, keys)
+        if device is not None:
+            args = jax.device_put(args, device)
+        out = fn(*args)
+        np.asarray(out.p)
+        return _time.perf_counter() - t0
+
+    cache = ExecutableCache()
+    bucket = bucket_of(entries[0][0], entries[0][1], entries[0][2])
+    fn = cache.get(bucket, "bench")
+
+    # warm both programs (compile excluded from the timed passes)
+    run_sequential()
+    run_batched(fn)
+
+    seq_dts = [run_sequential() for _ in range(repeats)]
+    bat_dts = [run_batched(fn) for _ in range(repeats)]
+    dt_seq = statistics.median(seq_dts)
+    dt_bat = statistics.median(bat_dts)
+    n_chips = 1  # the batched program occupies exactly one chip
+
+    return {
+        "batch": batch,
+        "repeats": repeats,
+        "shape": bucket.short(),
+        "sequential_solves_per_sec": round(batch / dt_seq, 3),
+        "batched_solves_per_sec": round(batch / dt_bat, 3),
+        "solves_per_sec_per_chip": round(batch / dt_bat / n_chips, 3),
+        "serve_batch_speedup": round(dt_seq / dt_bat, 3),
+        "serve_p50_latency_s": round(dt_bat, 5),
+        "cache": cache.stats(),
+    }
+
+
 def _latest_flight_dump():
     """Newest flight-recorder dump matching the configured dump path, so
     the recovery event links straight to the forensics artifact."""
@@ -498,12 +622,15 @@ def main():
     import jax
 
     # persistent compile cache: a prior successful TPU compile (e.g. the
-    # recovery watcher's banked run) makes later runs start in seconds
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache"),
+    # recovery watcher's banked run) makes later runs start in seconds.
+    # SAGECAL_COMPILE_CACHE overrides; the obs/perf helper also installs
+    # the cache-hit listener so the record can split warm/cold compiles.
+    from sagecal_tpu.obs.perf import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache(
+        os.environ.get("SAGECAL_COMPILE_CACHE")
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
     )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     # crash forensics + tracing for the bench itself: heartbeat while the
     # (possibly wedged-tunnel) TPU work runs, stall dump if it hangs.
@@ -600,6 +727,21 @@ def main():
                 coh_bf16=True,
             )
 
+    # serve-path throughput row: K same-shape solves as one vmapped
+    # program (through the serve executable cache) vs the sequential
+    # one-at-a-time loop.  Cheap (sub-minute small shape), so it rides
+    # every bench run and `diag gate` guards the serving win alongside
+    # the single-solve headline.  SAGECAL_BENCH_NO_SERVE=1 skips it.
+    serve_rec = None
+    if not os.environ.get("SAGECAL_BENCH_NO_SERVE"):
+        with tracer.span("bench", kind="run", variant="serve"):
+            try:
+                serve_rec = run_serve_bench(
+                    batch=8, repeats=5,
+                    device=jax.devices()[0] if on_tpu else None)
+            except Exception as exc:  # never sink the headline bench
+                sys.stderr.write(f"bench: serve bench failed: {exc}\n")
+
     cpu_measured = None
     if os.environ.get("SAGECAL_BENCH_MEASURE_CPU"):
         cpu_measured = _measure_cpu_subprocess(tilesz)
@@ -681,6 +823,13 @@ def main():
         rec["warm_start_iters_cold"] = warm["iters_cold"]
         rec["warm_start_iters_warm"] = warm["iters_warm"]
         rec["warm_start_speedup"] = warm["speedup"]
+    if serve_rec is not None:
+        # gate-able serve row (obs/perf.py knows the directions):
+        # throughput + batch speedup higher-better, p50 lower-better
+        rec["solves_per_sec_per_chip"] = serve_rec["solves_per_sec_per_chip"]
+        rec["serve_batch_speedup"] = serve_rec["serve_batch_speedup"]
+        rec["serve_p50_latency_s"] = serve_rec["serve_p50_latency_s"]
+        rec["serve_bench"] = serve_rec
     if bf16_variant is not None:
         # gate-able bf16-coherency row (obs/perf.py knows directions):
         # throughput higher-better, compiled bytes accessed lower-better

@@ -259,6 +259,13 @@ def main(argv=None):
         from sagecal_tpu.obs.diag import main as diag_main
 
         return diag_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # multi-tenant batch calibration service (sagecal_tpu/serve/):
+        # bucketed vmapped solves over a JSON request manifest; owns
+        # its own flag surface and exit-code mapping (apps/serve.py)
+        from sagecal_tpu.apps.serve import main as serve_main
+
+        return serve_main(argv[1:])
     if argv and argv[0] == "convert":
         # convert <ms> <h5> [spw] — multi-SPW MSs convert one window
         # per .h5 band file (the reference expects pre-split MSs)

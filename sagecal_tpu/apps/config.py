@@ -91,3 +91,33 @@ class RunConfig:
     # precision
     use_f64: bool = True
     verbose: bool = False  # -V
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """``sagecal-tpu serve``: the multi-tenant calibration service
+    (sagecal_tpu/serve/).  Solver fields are SERVICE-WIDE defaults; a
+    request manifest entry may override any of the per-request knobs
+    (serve/request.py SOLVER_KNOBS)."""
+
+    requests: str = ""          # request manifest (JSON) path
+    out_dir: str = "serve-out"  # solutions + result manifests
+    batch: int = 8              # lanes per bucketed batch solve
+    # solver defaults (same semantics as RunConfig)
+    max_emiter: int = 3
+    max_iter: int = 2
+    max_lbfgs: int = 10
+    lbfgs_m: int = 7
+    solver_mode: int = SM_OSLM_OSRLM_RLBFGS
+    nulow: float = 2.0
+    nuhigh: float = 30.0
+    randomize: bool = True
+    res_ratio: float = 5.0
+    abort_on_divergence: bool = False
+    # elastic: per-tenant checkpoint namespaces under
+    # <checkpoint_dir or out_dir/serve.ckpt>/tenants/<tenant>
+    resume: bool = False
+    checkpoint_every: int = 0
+    checkpoint_dir: Optional[str] = None
+    use_f64: bool = True
+    verbose: bool = False

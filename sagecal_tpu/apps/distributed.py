@@ -208,6 +208,9 @@ def run_distributed(
     AIC/MDL on each tile's rho-scaled solutions and logs the winner
     (the master's -M path, sagecal_master.cpp:991-993).
     """
+    from sagecal_tpu.obs.perf import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
     if multihost:
         jax.distributed.initialize()
     if datasets is None:

@@ -83,6 +83,9 @@ def run_federated(
     ceil(tilesz/minibatches), slave:138), then the Z -> Zavg manifold
     round-trip.  Returns per-tile lists of (dual_res trace, resets).
     """
+    from sagecal_tpu.obs.perf import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
     if datasets is None:
         datasets = sorted(glob.glob(cfg.dataset))
     if not datasets:

@@ -110,7 +110,12 @@ def run_fullbatch(cfg: RunConfig, log=print):
     CPU-pipeline + GPU-solver split (fullbatch_mode.cpp:371-464)."""
     import jax
 
+    from sagecal_tpu.obs.perf import enable_persistent_compilation_cache
     from sagecal_tpu.utils.platform import cpu_device
+
+    # SAGECAL_COMPILE_CACHE (or JAX_COMPILATION_CACHE_DIR): a restarted
+    # run deserializes yesterday's XLA executables instead of recompiling
+    enable_persistent_compilation_cache()
 
     try:
         accel = jax.devices()[0]

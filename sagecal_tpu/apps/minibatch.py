@@ -59,9 +59,13 @@ def run_minibatch(cfg: RunConfig, log=print):
     and the transfer audit (``SAGECAL_TRANSFER_AUDIT=1``) are opened
     here so a crash mid-epoch still flushes a loadable trace and
     restores stderr."""
-    from sagecal_tpu.obs.perf import TransferAudit
+    from sagecal_tpu.obs.perf import (
+        TransferAudit,
+        enable_persistent_compilation_cache,
+    )
     from sagecal_tpu.utils.profiling import trace
 
+    enable_persistent_compilation_cache()
     audit = TransferAudit()
     with trace(), audit:
         return _run_minibatch(cfg, log, audit)
@@ -164,11 +168,13 @@ def _run_minibatch(cfg: RunConfig, log, audit):
 
     # elastic execution (sagecal_tpu/elastic/): checkpoints at
     # (epoch, minibatch) boundaries carry p_bands (+ consensus Z and the
-    # Y duals).  The LBFGS curvature memory is deliberately NOT
-    # checkpointed — it rebuilds within a few batches — so a resumed run
-    # converges to the same answer but is not bit-for-bit identical to
-    # an uninterrupted one (the elastic bit-exactness contract covers
-    # the fullbatch and distributed drivers).
+    # Y duals) AND each band's LBFGS curvature memory (``mem{bi}.*``
+    # flattened-pytree entries), so a resumed run is bit-for-bit
+    # identical to an uninterrupted one — the same elastic contract as
+    # the fullbatch and distributed drivers (tests/test_elastic.py).
+    # Checkpoints from builds that predate the memory entries still
+    # resume (the memory rebuilds over the next few batches; convergent
+    # but not bit-exact).
     ckmgr = None
     resume_done = 0  # completed (epoch, minibatch) steps
     if cfg.resume or cfg.checkpoint_every > 0:
@@ -205,6 +211,19 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                     Z = jnp.asarray(rarrs["Z"], dtype)
                     Y_bands = [jnp.asarray(a, dtype)
                                for a in rarrs["Y_bands"]]
+                # LBFGS curvature memory (guarded per band: absent in
+                # checkpoints from older builds, and a band that never
+                # solved has none) — restoring it is what makes the
+                # resumed trajectory bit-exact
+                from sagecal_tpu.elastic.checkpoint import unflatten_state
+                from sagecal_tpu.solvers.lbfgs import LBFGSMemory
+
+                mem_template = LBFGSMemory.init(
+                    M * nchunk_max * 8 * N, cfg.lbfgs_m, dtype)
+                for bi in range(len(bands)):
+                    if f"mem{bi}.0" in rarrs:
+                        mem_bands[bi] = unflatten_state(
+                            f"mem{bi}", rarrs, mem_template)
 
     def solve_band(bi, data_band, cdata_band):
         p1, mem1 = bfgsfit_minibatch(
@@ -395,12 +414,17 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                 elog.emit("minibatch_done", epoch=epoch, minibatch=mb,
                           t0=t0, t1=t1, seconds=time.time() - tic)
             if ckmgr is not None:
+                from sagecal_tpu.elastic.checkpoint import flatten_state
+
                 arrs = {"p_bands": np.stack(
                     [np.asarray(p) for p in p_bands])}
                 if consensus_mode:
                     arrs["Z"] = np.asarray(Z)
                     arrs["Y_bands"] = np.stack(
                         [np.asarray(y) for y in Y_bands])
+                for bi, mem in enumerate(mem_bands):
+                    if mem is not None:
+                        arrs.update(flatten_state(f"mem{bi}", mem))
                 ckmgr.update(step, arrs, steps_done=step + 1,
                              run_id=manifest.run_id)
             log(f"epoch {epoch} minibatch {mb}: "

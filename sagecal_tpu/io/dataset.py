@@ -355,9 +355,20 @@ class TilePrefetcher:
         self._thread.join(timeout=max(deadline - _time.monotonic(), 0.1))
 
     def __exit__(self, *exc):
-        # signal cancellation, then drain so the worker can exit even on
-        # early break (without the event it would load every remaining
-        # tile before seeing the sentinel consumed)
+        self.close()
+        return False
+
+    def close(self) -> None:
+        """Full teardown: signal cancellation, drain so the worker can
+        exit even on early break (without the event it would load every
+        remaining tile before seeing the sentinel consumed), join, and
+        unregister from the crash-path registry.  Idempotent — the
+        serve path calls this per tenant queue as each drains, and a
+        SIGTERM between drains may race a second call from
+        :func:`cancel_active_prefetchers`."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
         self._stop.set()
         try:
             _ACTIVE_PREFETCHERS.remove(self)
@@ -381,7 +392,6 @@ class TilePrefetcher:
                     f"TilePrefetcher worker for {self._path!r} did not "
                     "exit within 5 s of context exit; it still holds an "
                     "open read handle", RuntimeWarning, stacklevel=2)
-        return False
 
     def __iter__(self):
         while True:

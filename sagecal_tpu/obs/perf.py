@@ -57,6 +57,7 @@ from sagecal_tpu.obs.registry import get_registry, telemetry_enabled
 _TRUTHY = ("1", "true", "yes", "on")
 _AUDIT_ENV = "SAGECAL_TRANSFER_AUDIT"
 _MEMPROF_ENV = "SAGECAL_MEMORY_PROFILE"
+_COMPILE_CACHE_ENV = "SAGECAL_COMPILE_CACHE"
 
 # ------------------------------------------------------------------ store
 
@@ -70,6 +71,13 @@ _COMPILE_EVENTS: List[dict] = []
 _MAX_COMPILE_EVENTS = 4096
 # per-phase peak-memory watermarks (bytes)
 _WATERMARKS: Dict[str, float] = {}
+# persistent-compilation-cache hit/miss counts observed through
+# jax.monitoring ('/jax/compilation_cache/cache_hits|cache_misses'):
+# a hit means XLA skipped the compile and deserialized a cached
+# executable — a WARM compile; note_compile still records the (short)
+# wall time, so the pair lets `diag perf` split warm from cold
+_CACHE_EVENTS: Dict[str, int] = {"hits": 0, "misses": 0}
+_cache_listener_installed = False
 
 
 def reset_perf_stats() -> None:
@@ -78,6 +86,8 @@ def reset_perf_stats() -> None:
         _FN_STATS.clear()
         _COMPILE_EVENTS.clear()
         _WATERMARKS.clear()
+        _CACHE_EVENTS["hits"] = 0
+        _CACHE_EVENTS["misses"] = 0
 
 
 def perf_stats() -> Dict[str, Dict[str, float]]:
@@ -143,6 +153,73 @@ def note_compile(name: str, lower_seconds: float, compile_seconds: float,
                       help="compiled.cost_analysis() bytes accessed of "
                            "the last compilation", fn=name)
     return ev
+
+
+def _cache_event_listener(event: str, **_kw) -> None:
+    """jax.monitoring listener: count persistent-compilation-cache
+    hits/misses and bump the registry so warm compiles are visible in
+    scrapes without waiting for an event-log drain."""
+    if event == "/jax/compilation_cache/cache_hits":
+        key = "hits"
+        name = "jit_persistent_cache_hits_total"
+        txt = ("XLA compilations served from the persistent compilation "
+               "cache (warm compiles: deserialization, no codegen)")
+    elif event == "/jax/compilation_cache/cache_misses":
+        key = "misses"
+        name = "jit_persistent_cache_misses_total"
+        txt = ("XLA compilations not found in the persistent compilation "
+               "cache (cold compiles: full codegen, then written back)")
+    else:
+        return
+    with _LOCK:
+        _CACHE_EVENTS[key] += 1
+    get_registry().counter_inc(name, 1.0, help=txt)
+
+
+def _install_cache_listener() -> None:
+    global _cache_listener_installed
+    if _cache_listener_installed:
+        return
+    try:
+        import jax.monitoring
+
+        jax.monitoring.register_event_listener(_cache_event_listener)
+        _cache_listener_installed = True
+    except Exception:
+        pass
+
+
+def compile_cache_stats() -> Dict[str, int]:
+    """Persistent-compilation-cache hit/miss counts observed so far."""
+    with _LOCK:
+        return dict(_CACHE_EVENTS)
+
+
+def enable_persistent_compilation_cache(path: Optional[str] = None):
+    """Point JAX's persistent compilation cache at ``path`` (default:
+    the ``SAGECAL_COMPILE_CACHE`` env var, falling back to
+    ``JAX_COMPILATION_CACHE_DIR``) and install the cache-hit monitoring
+    listener, so a second process compiling the same program deserializes
+    the cached executable instead of re-running XLA codegen.
+
+    Every app entry (fullbatch/minibatch/distributed/federated/serve)
+    and bench.py call this once at startup; with neither env var set it
+    is a no-op returning None, so bare library use is unaffected.  The
+    min-compile-time floor is dropped to 0 s: calibration programs are
+    few and large, so caching everything is strictly a win."""
+    path = (path or os.environ.get(_COMPILE_CACHE_ENV)
+            or os.environ.get("JAX_COMPILATION_CACHE_DIR"))
+    if not path:
+        return None
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        return None
+    _install_cache_listener()
+    return path
 
 
 def _cost_analysis(compiled) -> Tuple[Optional[float], Optional[float]]:
@@ -567,6 +644,12 @@ def emit_perf_events(elog, device=None) -> None:
         return
     for ev in drain_compile_events():
         elog.emit("jit_compile", **ev)
+    cache = compile_cache_stats()
+    if cache.get("hits") or cache.get("misses"):
+        # warm/cold split of this run's XLA compiles: hits came from the
+        # persistent compilation cache (deserialize, no codegen)
+        elog.emit("jit_cache_hit", hits=int(cache.get("hits", 0)),
+                  misses=int(cache.get("misses", 0)))
     marks = memory_watermarks()
     if marks:
         elog.emit("memory_watermark", phases=marks,
@@ -583,10 +666,16 @@ def aggregate_perf_events(events: List[dict]) -> dict:
     fns: Dict[str, Dict[str, float]] = {}
     mem: Dict[str, float] = {}
     transfers: Dict[str, int] = {}
+    cache = {"hits": 0, "misses": 0}
     snapshot = None
     for e in events:
         t = e.get("type")
-        if t == "jit_compile":
+        if t == "jit_cache_hit":
+            for k in ("hits", "misses"):
+                v = e.get(k)
+                if isinstance(v, (int, float)):
+                    cache[k] += int(v)
+        elif t == "jit_compile":
             st = fns.setdefault(str(e.get("fn", "?")), {
                 "compiles": 0, "lower_seconds": 0.0, "compile_seconds": 0.0,
                 "flops": 0.0, "bytes_accessed": 0.0,
@@ -610,7 +699,7 @@ def aggregate_perf_events(events: List[dict]) -> dict:
                 if isinstance(n, (int, float)):
                     transfers[str(d)] = transfers.get(str(d), 0) + int(n)
     return {"functions": fns, "memory": mem, "transfers": transfers,
-            "memory_snapshot": snapshot}
+            "compile_cache": cache, "memory_snapshot": snapshot}
 
 
 def _fmt_bytes(n: Optional[float]) -> str:
@@ -644,6 +733,11 @@ def format_perf_report(agg: dict) -> str:
     else:
         lines.append("no jit_compile events (run with SAGECAL_TELEMETRY=1 "
                      "and an instrumented path)")
+    cache = agg.get("compile_cache") or {}
+    if cache.get("hits") or cache.get("misses"):
+        h, m = int(cache.get("hits", 0)), int(cache.get("misses", 0))
+        lines.append(f"persistent compile cache: {h} warm (cache hit), "
+                     f"{m} cold (full compile)")
     mem = agg.get("memory") or {}
     if mem:
         lines.append("memory watermarks (peak per phase):")
@@ -671,10 +765,12 @@ GATE_HIGHER_BETTER = (
     "analytic_tflops_per_sec", "analytic_hbm_gb_per_sec",
     "mfu_vs_v5e_bf16_peak", "bw_util_vs_v5e_819gbps",
     "warm_start_speedup", "coh_bf16_iters_per_sec",
+    "solves_per_sec_per_chip", "serve_batch_speedup",
 )
 GATE_LOWER_BETTER = (
     "xla_cost_analysis_bytes_accessed", "peak_device_memory_bytes",
     "compile_seconds_total", "coh_bf16_xla_cost_analysis_bytes_accessed",
+    "serve_p50_latency_s",
 )
 # the metrics gated when present in BOTH records (others opt in via
 # --metric name=tol)
@@ -682,6 +778,7 @@ GATE_DEFAULT_METRICS = (
     "value", "xla_cost_analysis_bytes_accessed", "peak_device_memory_bytes",
     "warm_start_speedup", "coh_bf16_iters_per_sec",
     "coh_bf16_xla_cost_analysis_bytes_accessed",
+    "solves_per_sec_per_chip", "serve_batch_speedup", "serve_p50_latency_s",
 )
 GATE_DEFAULT_TOLERANCE = 0.10
 

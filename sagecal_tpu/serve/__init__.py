@@ -1,0 +1,25 @@
+"""Multi-tenant calibration service.
+
+Turns a request manifest (many independent tenant/dataset/tile solves)
+into full device programs: same-shape requests batch through the
+vmapped solver entries (solvers/batched.py), buckets compile once
+behind an executable cache, and per-tenant tile prefetch double-buffers
+the HDF5 I/O under the device compute.  ``sagecal-tpu serve`` is the
+CLI (apps/serve.py); USER_MANUAL.md "Serving" is the operator chapter.
+"""
+
+from sagecal_tpu.serve.bucket import BucketSpec, bucket_of, pad_indices
+from sagecal_tpu.serve.cache import ExecutableCache
+from sagecal_tpu.serve.request import (
+    SolveRequest,
+    load_requests,
+    result_manifest_path,
+    write_result_manifest,
+)
+from sagecal_tpu.serve.service import CalibrationService
+
+__all__ = [
+    "BucketSpec", "bucket_of", "pad_indices", "ExecutableCache",
+    "SolveRequest", "load_requests", "result_manifest_path",
+    "write_result_manifest", "CalibrationService",
+]

@@ -1,0 +1,96 @@
+"""Shape bucketing for the multi-tenant serve path.
+
+XLA compiles one executable per abstract signature, so a service that
+accepted arbitrary request shapes would recompile constantly.  The
+bucketer maps every loaded request onto a :class:`BucketSpec` — the
+complete abstract identity of the batched solve program — and the
+scheduler accumulates same-bucket requests into batches of the
+configured size.  A small set of buckets therefore covers the whole
+request mix with a small set of compiled executables (serve/cache.py).
+
+The spec must capture EVERYTHING that changes the compiled program:
+
+- array shapes: stations, baseline rows, tile size, channels, cluster
+  count, chunk padding, the 8N gain dof;
+- dtype (f32/f64 runs never share an executable);
+- the VisData STATIC fields (``freq0``, ``deltaf``, ``deltat`` ride in
+  the pytree treedef, not in array data — two requests that differ only
+  in observing frequency still need, and get, different executables).
+
+Solver options (SageConfig) are deliberately NOT part of the bucket:
+they key the executable cache separately via
+:func:`sagecal_tpu.elastic.checkpoint.config_fingerprint`, so the
+bucket answers "can these solves share one device program's shapes"
+and the fingerprint answers "same numerics".
+
+Ragged last batch: a bucket that drains with ``k < B`` pending requests
+is padded to ``B`` by REPLICATING real entries (round-robin over the
+``k``); the padded lanes solve real, finite data — no masked-to-zero
+degenerate systems — and :func:`pad_indices` hands back the validity
+mask so the scheduler discards their results on the host.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Tuple
+
+import numpy as np
+
+
+class BucketSpec(NamedTuple):
+    """Abstract identity of one batched-solve executable (batch axis
+    excluded — the executable is additionally specialized on B)."""
+
+    nstations: int
+    nbase: int          # baseline rows per tile (tilesz * nbase_per_t)
+    tilesz: int
+    nchan: int          # channels after the serve path's averaging
+    nclus: int          # M, sky clusters
+    nchunk_max: int     # chunk padding of the gains carry
+    dof: int            # 8 * nstations, per chunk
+    dtype: str          # "float32" / "float64"
+    freq0: float        # VisData static fields: same treedef or bust
+    deltaf: float
+    deltat: float
+
+    def short(self) -> str:
+        """Compact tag for jit names / logs / manifests, e.g.
+        ``N7xB84xT2xC1xM2``."""
+        return (f"N{self.nstations}xB{self.nbase}xT{self.tilesz}"
+                f"xC{self.nchan}xM{self.nclus}")
+
+
+def bucket_of(data, cdata, p0: np.ndarray) -> BucketSpec:
+    """The bucket a loaded request lands in, from its tile data,
+    cluster coherencies and initial gains."""
+    return BucketSpec(
+        nstations=int(data.nstations),
+        nbase=int(data.vis.shape[-1]),
+        tilesz=int(data.tilesz),
+        nchan=int(data.vis.shape[0]),
+        nclus=int(cdata.coh.shape[0]),
+        nchunk_max=int(p0.shape[1]),
+        dof=int(p0.shape[2]),
+        dtype=str(np.asarray(p0).dtype),
+        freq0=float(data.freq0),
+        deltaf=float(data.deltaf),
+        deltat=float(data.deltat),
+    )
+
+
+def pad_indices(k: int, batch: int) -> Tuple[List[int], np.ndarray]:
+    """Source indices filling a ragged group of ``k`` real entries up
+    to ``batch`` lanes, plus the per-lane validity mask.
+
+    ``k >= batch`` is the full-batch case (identity, all valid);
+    ``k < batch`` replicates real entries round-robin into the padding
+    lanes.  ``k == 0`` is a caller bug."""
+    if k <= 0:
+        raise ValueError("pad_indices: empty bucket group")
+    if k >= batch:
+        idx = list(range(k))
+        return idx, np.ones(k, dtype=bool)
+    idx = list(range(k)) + [i % k for i in range(batch - k)]
+    valid = np.zeros(batch, dtype=bool)
+    valid[:k] = True
+    return idx, valid

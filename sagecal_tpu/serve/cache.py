@@ -1,0 +1,83 @@
+"""Bucketed executable cache for the serve path.
+
+One entry per ``(BucketSpec, solver fingerprint)``: a named
+``instrumented_jit`` wrapper of the vmapped batched solve
+(:func:`sagecal_tpu.solvers.batched.sagefit_packed_batch`).  Reusing
+the SAME wrapper object for every same-bucket batch is what makes the
+second submission of an already-bucketed shape compile nothing — jax
+caches the executable on the wrapper, and the wrapper's
+``perf_stats()`` entry proves it (``compiles == 1`` across N batches).
+
+Hit/miss counters live in two places on purpose:
+
+- plain ints on the cache object (``hits``/``misses``/``stats()``) so
+  tests and the bench can assert reuse with telemetry off;
+- registry counters ``serve_executable_cache_{hits,misses}_total``
+  (labelled by bucket) so ``diag prom`` exports them in production.
+
+This cache is per-service-instance and in-memory; the CROSS-process
+layer underneath it is the persistent XLA compilation cache
+(``SAGECAL_COMPILE_CACHE``, obs/perf.py): a restarted server misses
+here on first touch of each bucket but deserializes yesterday's
+executable instead of recompiling.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Tuple
+
+from sagecal_tpu.serve.bucket import BucketSpec
+
+
+class ExecutableCache:
+    """Maps ``(bucket, fingerprint)`` -> the jitted batched-solve
+    callable, building (and counting) on miss."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[BucketSpec, str], Callable] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, bucket: BucketSpec, fingerprint: str) -> Callable:
+        """The executable wrapper for this bucket+numerics, creating it
+        on first touch.  The returned callable has the
+        ``sagefit_packed_batch`` signature and donates ``p0``."""
+        key = (bucket, fingerprint)
+        with self._lock:
+            fn = self._entries.get(key)
+            if fn is not None:
+                self.hits += 1
+                self._count("hits", bucket)
+                return fn
+            self.misses += 1
+            self._count("misses", bucket)
+            from sagecal_tpu.obs.perf import instrumented_jit
+            from sagecal_tpu.solvers.batched import sagefit_packed_batch
+
+            # named per bucket so `diag perf` attributes compile time
+            # to the shape class that paid it
+            fn = instrumented_jit(
+                sagefit_packed_batch,
+                name=f"serve_batch[{bucket.short()}#{fingerprint[:8]}]",
+                donate_argnames=("p0",),
+            )
+            self._entries[key] = fn
+            return fn
+
+    def _count(self, kind: str, bucket: BucketSpec) -> None:
+        try:
+            from sagecal_tpu.obs.registry import get_registry
+
+            get_registry().counter_inc(
+                f"serve_executable_cache_{kind}_total",
+                help="serve bucketed-executable cache lookups "
+                     f"({kind})", bucket=bucket.short())
+        except Exception:
+            pass
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "entries": len(self._entries)}

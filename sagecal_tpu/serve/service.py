@@ -1,0 +1,464 @@
+"""Multi-tenant calibration service: the batch scheduler.
+
+One process serves many independent (tenant, dataset, tile) solve
+requests.  Three mechanisms turn that request mix into full device
+programs instead of a one-at-a-time dispatch loop:
+
+1. **vmapped batch solves** — same-bucket requests stack into ONE
+   jitted program (solvers/batched.py); solves/sec scales with the
+   batch because the dispatch floor and the under-utilized small-shape
+   kernels are paid once per batch, not once per request.
+2. **bucketed executable cache** — requests bucket by abstract shape
+   (serve/bucket.py) and numerics fingerprint; each bucket compiles
+   once and every later batch of that shape reuses the executable
+   (serve/cache.py proves it with hit counters + ``compiles == 1``).
+3. **double-buffered prefetch** — every (tenant, dataset) stream gets
+   its own io/dataset.py :class:`TilePrefetcher` with ``depth=2``, so
+   the HDF5 read + host packing of the next requests overlaps the
+   device solve of the current batch; prefetchers are closed (threads
+   reaped) as each stream drains, and remain registered with the
+   obs/flight.py crash path until then.
+
+Scheduling is round-robin across tenants: each turn pops one request
+from one tenant's queue, so a tenant with a deep queue cannot starve
+the others; batches therefore interleave tenants whenever their
+requests share a bucket (the executable doesn't care whose data it
+solves).
+
+Elastic: each tenant owns a namespaced CheckpointManager
+(``<ckpt_dir>/tenants/<tenant>``) recording which of its requests have
+completed; a preempted server re-run with ``--resume`` skips those and
+drains only the remainder (results already on disk are untouched).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from sagecal_tpu.serve.bucket import BucketSpec, bucket_of, pad_indices
+from sagecal_tpu.serve.cache import ExecutableCache
+from sagecal_tpu.serve.request import SolveRequest, write_result_manifest
+
+
+def _merge_sage_config(cfg, req: SolveRequest):
+    """Request solver knobs (None = inherit) over the service-wide
+    ServeConfig defaults -> (SageConfig, numerics fingerprint)."""
+    from sagecal_tpu.elastic.checkpoint import config_fingerprint
+    from sagecal_tpu.obs import telemetry_enabled
+    from sagecal_tpu.solvers.sage import SageConfig
+
+    knobs = dict(
+        solver_mode=(cfg.solver_mode if req.solver_mode is None
+                     else req.solver_mode),
+        max_emiter=(cfg.max_emiter if req.max_emiter is None
+                    else req.max_emiter),
+        max_iter=cfg.max_iter if req.max_iter is None else req.max_iter,
+        max_lbfgs=(cfg.max_lbfgs if req.max_lbfgs is None
+                   else req.max_lbfgs),
+        lbfgs_m=cfg.lbfgs_m if req.lbfgs_m is None else req.lbfgs_m,
+        nulow=cfg.nulow if req.nulow is None else req.nulow,
+        nuhigh=cfg.nuhigh if req.nuhigh is None else req.nuhigh,
+        randomize=(cfg.randomize if req.randomize is None
+                   else req.randomize),
+    )
+    scfg = SageConfig(
+        collect_telemetry=False,  # batched lanes report via quality
+        collect_quality=True,     # per-request verdicts are the product
+        **knobs,
+    )
+    fp = config_fingerprint(use_f64=cfg.use_f64,
+                            collect=telemetry_enabled(), **knobs)
+    return scfg, fp
+
+
+class _Entry:
+    """One loaded, solve-ready request."""
+
+    __slots__ = ("req", "data", "cdata", "p0", "key", "scfg", "meta",
+                 "nclus", "nchunk_max")
+
+    def __init__(self, req, data, cdata, p0, key, scfg, meta,
+                 nclus, nchunk_max):
+        self.req = req
+        self.data = data
+        self.cdata = cdata
+        self.p0 = p0
+        self.key = key
+        self.scfg = scfg
+        self.meta = meta
+        self.nclus = nclus
+        self.nchunk_max = nchunk_max
+
+
+class CalibrationService:
+    """Drains a request manifest through bucketed batch solves.
+
+    ``run()`` returns a summary dict (per-request results, latency
+    percentiles, executable-cache stats) used by the CLI, the bench and
+    the tests."""
+
+    def __init__(self, cfg, log=print, device=None):
+        self.cfg = cfg
+        self.log = log
+        self.device = device
+        self.cache = ExecutableCache()
+        self._sky_cache: Dict[tuple, tuple] = {}
+        self._results: List[Dict[str, Any]] = []
+        self._latencies: List[float] = []
+        self._diverged_abort: Optional[tuple] = None
+
+    # -- data loading --------------------------------------------------
+
+    def _sky(self, req: SolveRequest, ra0, dec0, dtype):
+        from sagecal_tpu.io.skymodel import load_sky
+
+        key = (os.path.abspath(req.sky_model),
+               os.path.abspath(req.cluster_file),
+               float(ra0), float(dec0), str(dtype))
+        hit = self._sky_cache.get(key)
+        if hit is None:
+            hit = load_sky(req.sky_model, req.cluster_file, ra0, dec0,
+                           dtype=dtype)
+            self._sky_cache[key] = hit
+        return hit
+
+    def _load_entry(self, req: SolveRequest, data, meta) -> _Entry:
+        """Tile data (already prefetched) -> solve-ready entry:
+        coherencies, identity gains carry, per-request RNG key."""
+        import jax
+        import jax.numpy as jnp
+
+        from sagecal_tpu.core.types import identity_jones, jones_to_params
+        from sagecal_tpu.solvers.sage import build_cluster_data
+
+        dtype = np.float64 if self.cfg.use_f64 else np.float32
+        cdtype = np.complex128 if self.cfg.use_f64 else np.complex64
+        clusters, cdefs, shapelets = self._sky(
+            req, meta.ra0, meta.dec0, dtype)
+        nchunks = [cd.nchunk for cd in cdefs]
+        nchunk_max = max(nchunks)
+        M = len(clusters)
+        N = meta.nstations
+        cdata = build_cluster_data(data, clusters, nchunks,
+                                   shapelets=shapelets)
+        eye = jones_to_params(identity_jones(N, cdtype))
+        p0 = np.asarray(
+            jnp.broadcast_to(eye, (M, nchunk_max, 8 * N)).astype(dtype))
+        scfg, fp = _merge_sage_config(self.cfg, req)
+        # per-request key derived from the request id: deterministic
+        # across restarts, independent across lanes
+        seed = int.from_bytes(req.request_id.encode()[:4].ljust(4, b"\0"),
+                              "little")
+        key = np.asarray(jax.random.PRNGKey(seed))
+        entry = _Entry(req, data, cdata, p0, key, scfg, meta, M,
+                       nchunk_max)
+        return entry, fp
+
+    # -- batch dispatch ------------------------------------------------
+
+    def _dispatch(self, bucket: BucketSpec, fingerprint: str,
+                  entries: List[_Entry], batch: int, elog,
+                  t_enqueue: float, padded_flush: bool) -> None:
+        """Stack ``entries`` into one vmapped solve; unpack each real
+        lane into its request's solutions file + result manifest."""
+        import jax
+
+        idx, valid = pad_indices(len(entries), batch)
+        k = len(entries)
+
+        def stack(get):
+            return jax.tree_util.tree_map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                *[get(entries[i]) for i in idx])
+
+        data_b = stack(lambda e: e.data.replace(vis=None))
+        cdata_b = stack(lambda e: e.cdata._replace(coh=None))
+        vis = np.stack([np.asarray(entries[i].data.vis) for i in idx])
+        coh = np.stack([np.asarray(entries[i].cdata.coh) for i in idx])
+        p0 = np.stack([entries[i].p0 for i in idx])
+        keys = np.stack([entries[i].key for i in idx])
+        scfg = entries[0].scfg
+
+        fn = self.cache.get(bucket, fingerprint)
+        args = (data_b, cdata_b, vis.real, vis.imag, coh.real, coh.imag,
+                p0, scfg, keys)
+        if self.device is not None:
+            args = jax.device_put(args, self.device)
+        tic = time.time()
+        out = fn(*args)
+        # materialize on host before unpacking lanes (one sync)
+        p_host = np.asarray(out.p)
+        res0_host = np.asarray(out.res_0)
+        res1_host = np.asarray(out.res_1)
+        div_host = np.asarray(out.diverged)
+        nu_host = np.asarray(out.mean_nu)
+        solve_s = time.time() - tic
+        if elog is not None:
+            elog.emit("serve_batch_dispatched", bucket=bucket.short(),
+                      fingerprint=fingerprint[:12], size=k,
+                      batch=len(idx), padded=padded_flush,
+                      seconds=solve_s,
+                      cache=self.cache.stats())
+        for lane in range(k):
+            if not valid[lane]:
+                continue
+            self._finish_request(
+                entries[lane], bucket, lane, len(idx),
+                p_host[lane], float(res0_host[lane]),
+                float(res1_host[lane]), bool(div_host[lane]),
+                float(nu_host[lane]),
+                None if out.quality is None else jax.tree_util.tree_map(
+                    lambda x: x[lane], out.quality),
+                elog, t_enqueue)
+
+    def _finish_request(self, entry: _Entry, bucket, lane, batch,
+                        p, res0, res1, diverged, mean_nu, quality,
+                        elog, t_enqueue) -> None:
+        from sagecal_tpu.core.types import params_to_jones
+        from sagecal_tpu.io import solutions as solio
+        from sagecal_tpu.obs.quality import check_and_emit
+        from sagecal_tpu.obs.registry import get_registry
+
+        req, meta = entry.req, entry.meta
+        # divergence guard, same residual-ratio policy as fullbatch
+        ratio_blown = (not np.isfinite(res1) or res1 == 0.0
+                       or res1 > self.cfg.res_ratio * res0)
+        verdict, reasons = "ok", []
+        if quality is not None:
+            verdict, reasons = check_and_emit(
+                elog, quality, log=self.log, tile=req.t0, app="serve",
+                tenant=req.tenant, request_id=req.request_id)
+        if diverged or ratio_blown:
+            if verdict != "diverged" and elog is not None:
+                elog.emit("solver_diverged",
+                          reasons=[f"residual_ratio:{res0:.3e}->{res1:.3e}"],
+                          tile=req.t0, app="serve", tenant=req.tenant,
+                          request_id=req.request_id)
+            verdict = "diverged"
+            reasons = reasons + [f"residual_ratio:{res0:.3e}->{res1:.3e}"]
+
+        out_path = req.out_solutions or os.path.join(
+            self.cfg.out_dir, f"{req.request_id}.solutions")
+        N, M, nchunk_max = meta.nstations, entry.nclus, entry.nchunk_max
+        jsol = np.asarray(params_to_jones(p)).reshape(
+            M * nchunk_max, N, 2, 2)
+        with open(out_path, "w") as fh:
+            solio.write_header(
+                fh, meta.freq0, meta.deltaf,
+                meta.deltat * req.tilesz / 60.0, N, M, M * nchunk_max)
+            solio.append_solutions(fh, jsol)
+
+        latency = time.time() - t_enqueue
+        self._latencies.append(latency)
+        result = {
+            "request_id": req.request_id, "tenant": req.tenant,
+            "dataset": req.dataset, "t0": req.t0, "tilesz": req.tilesz,
+            "verdict": verdict, "reasons": reasons,
+            "res_0": res0, "res_1": res1, "mean_nu": mean_nu,
+            "bucket": bucket.short(), "batch": batch, "lane": lane,
+            "solutions": out_path, "latency_s": latency,
+        }
+        write_result_manifest(self.cfg.out_dir, result)
+        self._results.append(result)
+        reg = get_registry()
+        reg.counter_inc("serve_requests_total", tenant=req.tenant,
+                        verdict=verdict,
+                        help="serve requests completed, by verdict")
+        reg.observe("serve_request_latency_seconds", latency,
+                    tenant=req.tenant,
+                    help="submit -> result-manifest latency")
+        if elog is not None:
+            elog.emit("request_done", **result)
+        self.log(f"request {req.request_id} [{req.tenant}]: "
+                 f"{verdict} residual {res0:.6f} -> {res1:.6f} "
+                 f"(bucket {bucket.short()}, lane {lane}/{batch}, "
+                 f"{latency:.1f}s)")
+        if verdict == "diverged" and self.cfg.abort_on_divergence \
+                and self._diverged_abort is None:
+            # raised after the whole batch's manifests are on disk
+            self._diverged_abort = (req.request_id, req.t0, reasons)
+
+    # -- the scheduler -------------------------------------------------
+
+    def run(self, requests: List[SolveRequest], elog=None
+            ) -> Dict[str, Any]:
+        import jax
+
+        from sagecal_tpu.elastic.checkpoint import (
+            CheckpointManager, config_fingerprint,
+        )
+        from sagecal_tpu.io.dataset import TilePrefetcher, VisDataset
+        from sagecal_tpu.obs.quality import DivergenceAbort
+        from sagecal_tpu.obs.registry import get_registry
+
+        cfg, reg = self.cfg, get_registry()
+        t_start = time.time()
+        os.makedirs(cfg.out_dir, exist_ok=True)
+
+        # -- per-tenant elastic state: which requests already finished
+        tenants = list(dict.fromkeys(r.tenant for r in requests))
+        by_tenant = {t: [r for r in requests if r.tenant == t]
+                     for t in tenants}
+        ckmgrs: Dict[str, CheckpointManager] = {}
+        done_flags: Dict[str, np.ndarray] = {}
+        skipped = 0
+        for t in tenants:
+            reqs = by_tenant[t]
+            fp = config_fingerprint(
+                app="serve", tenant=t,
+                requests=[(r.request_id, os.path.abspath(r.dataset),
+                           r.t0, r.tilesz, r.in_column) for r in reqs],
+                use_f64=cfg.use_f64)
+            flags = np.zeros(len(reqs), np.uint8)
+            if cfg.resume or cfg.checkpoint_every > 0:
+                mgr = CheckpointManager(
+                    os.path.join(
+                        cfg.checkpoint_dir
+                        or os.path.join(cfg.out_dir, "serve.ckpt"),
+                        "tenants", t),
+                    fp, "serve", every=max(cfg.checkpoint_every, 1),
+                    elog=elog, log=self.log)
+                ckmgrs[t] = mgr
+                if cfg.resume:
+                    found = mgr.resume()
+                    if found is not None:
+                        rmeta, rarr, rpath = found
+                        flags = np.asarray(
+                            rarr["done"], np.uint8).copy()
+                        n = int(flags.sum())
+                        skipped += n
+                        self.log(f"resume[{t}]: {n}/{len(reqs)} "
+                                 f"requests already served ({rpath})")
+                        if elog is not None:
+                            for r, f in zip(reqs, flags):
+                                if f:
+                                    elog.emit("request_skipped_resume",
+                                              request_id=r.request_id,
+                                              tenant=t)
+            done_flags[t] = flags
+
+        # -- queues (post-resume) and double-buffered prefetch streams.
+        # A stream is one (tenant, dataset, tilesz, column) request
+        # sequence; its prefetcher loads tiles in exactly the order the
+        # round-robin will pop them.
+        queues = {
+            t: collections.deque(
+                r for r, f in zip(by_tenant[t], done_flags[t]) if not f)
+            for t in tenants}
+        for t in tenants:
+            reg.gauge_set("serve_queue_depth", len(queues[t]),
+                          tenant=t,
+                          help="requests waiting in this tenant's queue")
+
+        dtype = np.float64 if cfg.use_f64 else np.float32
+        streams: Dict[tuple, dict] = {}
+        for t in tenants:
+            for r in queues[t]:
+                skey = (t, os.path.abspath(r.dataset), r.tilesz,
+                        r.in_column)
+                streams.setdefault(skey, {"t0s": [], "reqs": []})
+                streams[skey]["t0s"].append(r.t0)
+                streams[skey]["reqs"].append(r)
+        for skey, s in streams.items():
+            _, dpath, tilesz, column = skey
+            ds = VisDataset(dpath, "r")
+            s["meta"] = ds.meta
+            ds.close()
+            s["pf"] = TilePrefetcher(
+                dpath, s["t0s"],
+                [dict(average_channels=True, dtype=dtype, column=column)],
+                tilesz, depth=2)
+            s["it"] = iter(s["pf"].__enter__())
+
+        pending: Dict[tuple, List[_Entry]] = collections.defaultdict(list)
+        served = 0
+
+        def mark_done(entry: _Entry) -> None:
+            nonlocal served
+            served += 1
+            t = entry.req.tenant
+            i = next(i for i, r in enumerate(by_tenant[t])
+                     if r.request_id == entry.req.request_id)
+            done_flags[t][i] = 1
+            if t in ckmgrs:
+                ckmgrs[t].update(
+                    int(done_flags[t].sum()) - 1,
+                    {"done": done_flags[t]},
+                    requests_done=int(done_flags[t].sum()),
+                    tenant=t)
+
+        def dispatch(bkey, padded_flush):
+            bucket, fp = bkey
+            entries = pending.pop(bkey)
+            self._dispatch(bucket, fp, entries, cfg.batch, elog,
+                           t_start, padded_flush)
+            for e in entries:
+                mark_done(e)
+
+        try:
+            # round-robin drain: one request per tenant per turn
+            alive = True
+            while alive:
+                alive = False
+                for t in tenants:
+                    if not queues[t]:
+                        continue
+                    alive = True
+                    req = queues[t].popleft()
+                    reg.gauge_set("serve_queue_depth", len(queues[t]),
+                                  tenant=t)
+                    skey = (t, os.path.abspath(req.dataset),
+                            req.tilesz, req.in_column)
+                    t0, (data,) = next(streams[skey]["it"])
+                    if t0 != req.t0:
+                        raise RuntimeError(
+                            f"prefetch order mismatch for "
+                            f"{req.request_id}: got tile {t0}, "
+                            f"expected {req.t0}")
+                    entry, fp = self._load_entry(
+                        req, data, streams[skey]["meta"])
+                    bkey = (bucket_of(data, entry.cdata, entry.p0), fp)
+                    pending[bkey].append(entry)
+                    if len(pending[bkey]) >= cfg.batch:
+                        dispatch(bkey, padded_flush=False)
+            # ragged flush: pad the leftovers of each bucket
+            for bkey in list(pending):
+                dispatch(bkey, padded_flush=True)
+        finally:
+            # streams drain exactly when their queues do, so on the
+            # success path every worker already consumed its sentinel;
+            # on an error path close() reaps them (satellite of the
+            # crash-flusher contract: no leaked reader threads)
+            for s in streams.values():
+                s["pf"].close()
+            for mgr in ckmgrs.values():
+                mgr.flush()
+                mgr.close()
+
+        wall = time.time() - t_start
+        lat = sorted(self._latencies)
+        p50 = lat[len(lat) // 2] if lat else 0.0
+        summary = {
+            "requests": len(requests), "served": served,
+            "skipped_resume": skipped,
+            "tenants": len(tenants), "buckets": self.cache.stats(),
+            "wall_s": wall,
+            "solves_per_sec": served / wall if wall > 0 else 0.0,
+            "p50_latency_s": p50,
+            "results": self._results,
+        }
+        if elog is not None:
+            elog.emit("run_done", app="serve",
+                      **{k: v for k, v in summary.items()
+                         if k != "results"})
+        if self._diverged_abort is not None:
+            rid, t0, reasons = self._diverged_abort
+            raise DivergenceAbort(
+                f"request {rid} (tile {t0}) diverged: "
+                f"{'; '.join(reasons)}")
+        return summary

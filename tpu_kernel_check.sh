@@ -168,3 +168,25 @@ v = validate_solutions('$ELDIR/sol_fused.txt')
 assert v['n_intervals'] == 4 and v['torn_rows'] == 0, v
 print('fused elastic smoke ok:', v)" \
   || { echo "fused elastic smoke validate FAILED"; exit 1; }
+echo "=== multi-tenant serve smoke (CPU, synthetic mixed shapes)"
+SRVDIR=$(mktemp -d)
+JAX_PLATFORMS=cpu timeout 420 python -m sagecal_tpu.apps.cli serve \
+  --synthetic 6 --tenants 2 --batch 2 --out-dir "$SRVDIR" \
+  || { echo "serve smoke FAILED rc=$?"; exit 1; }
+JAX_PLATFORMS=cpu timeout 60 python - "$SRVDIR" <<'PY'
+import glob, json, os, sys
+out = sys.argv[1]
+res = sorted(glob.glob(os.path.join(out, "*.result.json")))
+assert len(res) == 6, f"expected 6 result manifests, got {res}"
+buckets = set()
+for f in res:
+    r = json.load(open(f))
+    assert r.get("verdict"), (f, r)
+    assert os.path.exists(r["solutions"]), (f, r["solutions"])
+    buckets.add(r["bucket"])
+# --synthetic alternates two shape classes -> two compiled buckets
+assert len(buckets) == 2, buckets
+print("serve smoke ok:", len(res), "requests,", sorted(buckets))
+PY
+[ $? = 0 ] || { echo "serve smoke validate FAILED"; exit 1; }
+rm -rf "$SRVDIR"
