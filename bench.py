@@ -476,6 +476,110 @@ def _measure_cpu_subprocess(tilesz=TILESZ, timeout=1800.0):
     return None
 
 
+def _admm_comms_main(ndev=8, M=10, N=8, Nf=8, Npoly=2, nadmm=11,
+                     cluster_groups=5):
+    """Measure the mesh ADMM's per-round collective bytes, grouped vs
+    transpose-reduced z-step (arXiv:1504.02147), by AOT-compiling both
+    programs on ``ndev`` virtual CPU devices and walking the compiled
+    HLO (obs/perf.collective_cost_analysis) — no execution, so the
+    numbers are the program's actual collective schedule, not a timing.
+    Runs in the comms-bench SUBPROCESS (see run_admm_comms_bench);
+    prints one ADMMCOMMS JSON line."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    jax.config.update("jax_enable_x64", True)
+
+    from sagecal_tpu.core.types import jones_to_params
+    from sagecal_tpu.io.simulate import (
+        corrupt_and_observe, make_visdata, random_jones,
+    )
+    from sagecal_tpu.obs.perf import collective_cost_analysis
+    from sagecal_tpu.ops.rime import point_source_batch
+    from sagecal_tpu.parallel import consensus
+    from sagecal_tpu.parallel.mesh import make_admm_mesh_fn, stack_for_mesh
+    from sagecal_tpu.solvers.lm import LMConfig
+    from sagecal_tpu.solvers.sage import build_cluster_data
+
+    freqs = np.linspace(120e6, 180e6, Nf)
+    f0 = 150e6
+    clusters = [
+        point_source_batch([0.02 * k - 0.1], [0.01 * k], [1.0 + 0.1 * k],
+                           f0=f0, dtype=jnp.float64)
+        for k in range(M)
+    ]
+    bands, p0s = [], []
+    for f in range(Nf):
+        data = make_visdata(nstations=N, tilesz=2, nchan=1, freq0=f0,
+                            seed=f, dtype=np.float64)
+        jones = random_jones(M, N, seed=f, amp=0.2, dtype=np.complex128)
+        data = corrupt_and_observe(data, clusters, jones=jnp.asarray(jones),
+                                   noise_sigma=1e-4, seed=f)
+        data = data.replace(freqs=jnp.asarray([freqs[f]], jnp.float64))
+        bands.append((data, build_cluster_data(data, clusters, [1] * M)))
+        p0s.append(jones_to_params(random_jones(
+            M, N, seed=500, amp=0.0, dtype=np.complex128))[:, None, :])
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ("freq",))
+    B = consensus.setup_polynomials(freqs, f0, Npoly,
+                                    consensus.POLY_ORDINARY)
+    args = (stack_for_mesh([b[0] for b in bands]),
+            stack_for_mesh([b[1] for b in bands]),
+            jnp.stack(p0s), jnp.full((Nf, M), 20.0, jnp.float64),
+            jnp.asarray(B))
+
+    def bytes_of(ccfg):
+        fn = make_admm_mesh_fn(mesh, nadmm=nadmm, max_emiter=1,
+                               plain_emiter=1, lm_config=LMConfig(itmax=4),
+                               bb_rho=False, consensus_cfg=ccfg)
+        comp = fn.inner_jit.lower(*args).compile()
+        return collective_cost_analysis(comp)
+
+    g = bytes_of(None)
+    r = bytes_of(consensus.ConsensusConfig(
+        zstep="reduced", cluster_groups=cluster_groups))
+    per_g = g["collective_bytes_per_round"]
+    per_r = r["collective_bytes_per_round"]
+    print("ADMMCOMMS " + json.dumps({
+        "admm_collective_bytes_per_round": per_r,
+        "admm_collective_bytes_per_round_grouped": per_g,
+        "admm_collective_bytes_reduction": round(per_g / max(per_r, 1), 3),
+        "admm_collective_ops_per_round": r["collective_ops_per_round"],
+        "shape": {"ndev": ndev, "M": M, "N": N, "Nf": Nf, "Npoly": Npoly,
+                  "nadmm": nadmm, "cluster_groups": cluster_groups},
+    }))
+
+
+def run_admm_comms_bench(timeout=900.0):
+    """The mesh-consensus communication row: per-round collective bytes
+    of the transpose-reduced z-step and its reduction over the grouped
+    baseline, at the 8-band shape the ISSUE gates on.  Pure AOT HLO
+    accounting in a fresh subprocess (8 virtual CPU devices — the
+    collective schedule is platform-independent program structure), so
+    the row is deterministic and rides CPU-fallback bench runs too.
+    Returns the ADMMCOMMS record dict or None."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    code = "import bench; bench._admm_comms_main()"
+    try:
+        rr = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout, capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        )
+        for line in rr.stdout.splitlines():
+            if line.startswith("ADMMCOMMS "):
+                return json.loads(line[len("ADMMCOMMS "):])
+        sys.stderr.write(
+            f"bench: admm comms bench produced no row "
+            f"(rc {rr.returncode}): {rr.stderr[-400:]}\n")
+    except Exception as exc:
+        sys.stderr.write(f"bench: admm comms bench failed: {exc}\n")
+    return None
+
+
 def run_serve_bench(batch=8, repeats=5, device=None,
                     nstations=16, tilesz=1, nclusters=2):
     """Serve-path throughput: ``batch`` independent same-shape solves
@@ -742,6 +846,16 @@ def main():
             except Exception as exc:  # never sink the headline bench
                 sys.stderr.write(f"bench: serve bench failed: {exc}\n")
 
+    # mesh-consensus communication row: per-round collective bytes of
+    # the transpose-reduced z-step vs grouped, from AOT HLO accounting
+    # in a subprocess (deterministic — no timing).  `diag gate` guards
+    # both directions: bytes/round must not grow, the reduction ratio
+    # must not shrink.  SAGECAL_BENCH_NO_COMMS=1 skips it.
+    comms_rec = None
+    if not os.environ.get("SAGECAL_BENCH_NO_COMMS"):
+        with tracer.span("bench", kind="run", variant="admm_comms"):
+            comms_rec = run_admm_comms_bench()
+
     cpu_measured = None
     if os.environ.get("SAGECAL_BENCH_MEASURE_CPU"):
         cpu_measured = _measure_cpu_subprocess(tilesz)
@@ -823,6 +937,14 @@ def main():
         rec["warm_start_iters_cold"] = warm["iters_cold"]
         rec["warm_start_iters_warm"] = warm["iters_warm"]
         rec["warm_start_speedup"] = warm["speedup"]
+    if comms_rec is not None:
+        # gate-able consensus-comms rows (obs/perf.py knows directions):
+        # bytes/round lower-better, reduction ratio higher-better
+        rec["admm_collective_bytes_per_round"] = (
+            comms_rec["admm_collective_bytes_per_round"])
+        rec["admm_collective_bytes_reduction"] = (
+            comms_rec["admm_collective_bytes_reduction"])
+        rec["admm_comms_bench"] = comms_rec
     if serve_rec is not None:
         # gate-able serve row (obs/perf.py knows the directions):
         # throughput + batch speedup higher-better, p50 lower-better

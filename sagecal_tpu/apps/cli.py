@@ -100,6 +100,26 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-P", "--npoly", type=int, default=2)
     ap.add_argument("-Q", "--poly-type", type=int, default=2)
     ap.add_argument("-r", "--admm-rho", type=float, default=5.0)
+    ap.add_argument("--consensus-zstep", choices=("grouped", "reduced"),
+                    default="grouped",
+                    help="consensus Z-step collective layout: 'reduced' "
+                    "moves only basis-sized Gram terms per round "
+                    "(transpose reduction) instead of the full "
+                    "replicated psum; bit-close (<=1e-6) to 'grouped'")
+    ap.add_argument("--consensus-cluster-groups", type=int, default=1,
+                    help=">1 decomposes each ADMM x-step below band "
+                    "granularity into this many cluster factor-node "
+                    "groups (fine-grained consensus; rounds get "
+                    "cheaper, the rotation covers all groups)")
+    ap.add_argument("--consensus-staleness", type=int, default=0,
+                    help=">0 bounded-staleness consensus rounds: bands "
+                    "may contribute Gram terms up to K rounds stale "
+                    "(rho-discounted); 0 = synchronous (bit-identical "
+                    "to the default loop)")
+    ap.add_argument("--consensus-staleness-discount", type=float,
+                    default=1.0,
+                    help="per-round rho discount applied to stale "
+                    "consensus contributions (1.0 = undamped)")
     ap.add_argument("-C", "--adaptive-rho", type=int, default=0,
                     help="if >0, adaptive (Barzilai-Borwein) update of "
                     "the ADMM regularization (ref -C aadmm, default off "
@@ -228,6 +248,10 @@ def config_from_args(args) -> RunConfig:
         npoly=args.npoly,
         poly_type=args.poly_type,
         admm_rho=args.admm_rho,
+        consensus_zstep=args.consensus_zstep,
+        consensus_cluster_groups=args.consensus_cluster_groups,
+        consensus_staleness=args.consensus_staleness,
+        consensus_staleness_discount=args.consensus_staleness_discount,
         use_f64=not args.f32,
         verbose=args.verbose,
         influence=args.influence,

@@ -49,6 +49,20 @@ class RunConfig:
     npoly: int = 2  # -P
     poly_type: int = 2  # -Q (POLY_* in parallel.consensus)
     admm_rho: float = 5.0  # -r
+    # consensus-layer scaling knobs (parallel/consensus.ConsensusConfig
+    # on the mesh path; parallel/async_consensus on the host minibatch
+    # loop — see USER_MANUAL "Scaling ADMM"):
+    # zstep "reduced" = transpose-reduced Z-step (basis-sized Gram
+    # collectives instead of full-solution psums, arXiv:1504.02147)
+    consensus_zstep: str = "grouped"
+    # >1 splits each x-step below band granularity into this many
+    # cluster factor-node groups (arXiv:1603.02526)
+    consensus_cluster_groups: int = 1
+    # >0 allows bands to contribute Gram terms up to this many rounds
+    # stale (rho-discounted by consensus_staleness_discount per round);
+    # 0 = fully synchronous rounds
+    consensus_staleness: int = 0
+    consensus_staleness_discount: float = 1.0
     # beam (-B: 0 none, 1 array, 2 array+element, 3 element, 4/5/6 the
     # same per-channel/wideband — main.cpp DOBEAM_* codes)
     beam_mode: int = 0

@@ -105,7 +105,7 @@ def _check_band_consistency(metas, log):
 
 def _emit_admm_attribution(tracer, elog, log, t0, admm_seconds,
                            admm_start_unix, fratios, nf, nadmm, nslots,
-                           plain_emiter, max_emiter):
+                           plain_emiter, max_emiter, cluster_groups=1):
     """Host-side straggler attribution for one tile's mesh ADMM window.
 
     The whole nadmm loop is ONE jitted shard_map dispatch, so per-band /
@@ -128,9 +128,14 @@ def _emit_admm_attribution(tracer, elog, log, t0, admm_seconds,
         admm_id = tracer.add_span(
             "admm", admm_seconds, start_unix=admm_start_unix,
             kind="admm", tile=t0, nadmm=nadmm, nf=nf)
+        # per-round weights track each round's ACTIVE slot's unflagged
+        # rows (slot_rows) — a flag-skewed band's rounds bill more of
+        # the measured window instead of papering over the straggler
         rsecs = band_attribution(
             admm_seconds,
-            round_work_weights(nadmm, nslots, plain_emiter, max_emiter))
+            round_work_weights(nadmm, nslots, plain_emiter, max_emiter,
+                               slot_rows=weights,
+                               cluster_groups=cluster_groups))
         r_start = admm_start_unix
         for r, s in enumerate(rsecs):
             tracer.add_span("admm.round", s, parent_id=admm_id,
@@ -356,14 +361,50 @@ def _run_distributed_inner(
     # per-band trajectories also feed the consensus watchdog, so an
     # abort-enabled run collects them even with telemetry off
     collect = telemetry_enabled() or cfg.abort_on_divergence
-    fn = make_admm_mesh_fn(
-        mesh, nadmm=nadmm, max_emiter=cfg.max_emiter,
-        plain_emiter=max(cfg.max_emiter, 2),
-        lm_config=LMConfig(itmax=cfg.max_iter),
-        bb_rho=adaptive_rho, solver_mode=cfg.solver_mode,
-        spatial=spatial,
-        collect_trace=collect,
+
+    def _build_mesh_fn(band_weights=None):
+        # consensus-layer scaling knobs (parallel/consensus.
+        # ConsensusConfig): transpose-reduced z-step, fine-grained
+        # cluster factor groups, in-mesh staleness weighting
+        ccfg = consensus.ConsensusConfig(
+            zstep=cfg.consensus_zstep,
+            cluster_groups=max(cfg.consensus_cluster_groups, 1),
+            staleness=(cfg.consensus_staleness
+                       if cfg.consensus_staleness > 0 else None),
+            staleness_discount=cfg.consensus_staleness_discount,
+        )
+        if band_weights is not None:
+            import dataclasses as _dc
+
+            from sagecal_tpu.parallel.admm import factor_schedule
+
+            slot_s, group_s = factor_schedule(
+                nadmm, Nf_pad // ndev,
+                cluster_groups=max(cfg.consensus_cluster_groups, 1),
+                band_weights=band_weights, ndev=ndev,
+            )
+            ccfg = _dc.replace(ccfg, slot_schedule=slot_s,
+                               group_schedule=group_s)
+        return make_admm_mesh_fn(
+            mesh, nadmm=nadmm, max_emiter=cfg.max_emiter,
+            plain_emiter=max(cfg.max_emiter, 2),
+            lm_config=LMConfig(itmax=cfg.max_iter),
+            bb_rho=adaptive_rho, solver_mode=cfg.solver_mode,
+            spatial=spatial,
+            collect_trace=collect,
+            consensus_cfg=ccfg,
+        )
+
+    # fine-grained rounds rebalance their slot schedule on per-band
+    # unflagged-row counts, which are only known once the first tile's
+    # masks are on device — defer the build to the first tile then;
+    # everything else builds the program up front as before
+    _want_rebalance = (
+        cfg.consensus_cluster_groups > 1 and Nf_pad // ndev >= 1
+        and cfg.consensus_staleness <= 0
+        and cfg.consensus_staleness_discount == 1.0
     )
+    fn = None if _want_rebalance else _build_mesh_fn()
     manifest = RunManifest.collect(
         app="distributed", bands=Nf, nadmm=nadmm,
         solver_mode=cfg.solver_mode, n_clusters=M, n_stations=N,
@@ -421,6 +462,10 @@ def _run_distributed_inner(
             in_column=cfg.in_column, skip_tiles=cfg.skip_tiles,
             max_tiles=cfg.max_tiles, spatial_n0=spatial_n0,
             adaptive_rho=adaptive_rho,
+            consensus_zstep=cfg.consensus_zstep,
+            consensus_cluster_groups=cfg.consensus_cluster_groups,
+            consensus_staleness=cfg.consensus_staleness,
+            consensus_staleness_discount=cfg.consensus_staleness_discount,
         )
         ckmgr = CheckpointManager(
             cfg.checkpoint_dir or f"{cfg.out_solutions}.ckpt",
@@ -626,6 +671,13 @@ def _run_distributed_inner(
         rho = jnp.asarray(
             np.asarray(fratios)[:, None] * rho_m[None, :], dtype
         )
+        if fn is None:
+            # first tile: build the rebalanced fine-grained program on
+            # this tile's unflagged-row fractions (padded bands get
+            # zero weight -> their slots stop billing rounds)
+            bw = np.zeros((Nf_pad,))
+            bw[:Nf] = np.asarray(fratios[:Nf])
+            fn = _build_mesh_fn(band_weights=bw)
         admm_start_unix = time.time()
         t_dispatch = time.perf_counter()
         with timer.phase("dispatch"):
@@ -651,7 +703,8 @@ def _run_distributed_inner(
         band_secs, straggler = _emit_admm_attribution(
             tracer, elog, log, t0, admm_seconds, admm_start_unix,
             fratios, Nf, nadmm, Nf_pad // ndev,
-            max(cfg.max_emiter, 2), cfg.max_emiter)
+            max(cfg.max_emiter, 2), cfg.max_emiter,
+            cluster_groups=max(cfg.consensus_cluster_groups, 1))
         note_activity("tile", name=f"tile{t0}", seconds=admm_seconds)
         if mdl:
             # AIC/MDL consensus-order scan on this tile's rho-scaled

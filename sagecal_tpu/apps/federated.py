@@ -302,6 +302,16 @@ def _run_inner(cfg, datasets, handles, open_files, log, nadmm, epochs,
         dres_trace: List[float] = []
         resets_total = 0
         cost0 = None
+        # bounded-staleness coupling (--consensus-staleness K): the
+        # manifold-averaging consensus step runs every K+1 rounds, so a
+        # band's local trajectory may drift up to K rounds from the
+        # federated average before being pulled back — the federated
+        # analog of the minibatch loop's stale Gram terms.  K=0 (the
+        # default) averages every round, unchanged.
+        avg_every = max(int(cfg.consensus_staleness), 0) + 1
+        if avg_every > 1 and elog is not None and ti == 0:
+            elog.emit("async_schedule", staleness=avg_every - 1,
+                      avg_every=avg_every, nadmm=nadmm)
         for admm in range(nadmm):
             # real per-round span: the np.asarray(cost) below syncs the
             # round's device work, so the measured window is honest
@@ -312,7 +322,10 @@ def _run_inner(cfg, datasets, handles, open_files, log, nadmm, epochs,
                 for mb, (dst, cst) in enumerate(mb_data):
                     state, dres, cost = step_fn(dst, cst, state, rho, B)
                     dres_trace.append(float(dres))
-            state = avg_fn(state)
+            if (admm + 1) % avg_every == 0 or admm == nadmm - 1:
+                # always average on the last round so the written
+                # solutions reflect a coupled state
+                state = avg_fn(state)
             cost_np = np.asarray(cost)
             if cost0 is None:
                 cost0 = np.where(np.isfinite(cost_np), cost_np, np.inf)

@@ -86,6 +86,14 @@ def _run_minibatch(cfg: RunConfig, log, audit):
     N = meta.nstations
     bands = _band_slices(meta.nchan, cfg.bands)
     consensus_mode = cfg.admm_iters > 0 and cfg.bands > 1
+    # bounded-staleness consensus (--consensus-staleness K): bands
+    # refresh their Gram contributions on deterministic work-weighted
+    # periods instead of every round; K=0 keeps periods of all-ones and
+    # the unified round engine below reproduces the synchronous
+    # trajectory bit-for-bit (tests/test_async_consensus.py)
+    K_stale = max(int(cfg.consensus_staleness), 0)
+    sdisc = float(cfg.consensus_staleness_discount)
+    async_mode = consensus_mode and (K_stale > 0 or sdisc != 1.0)
 
     eye = jones_to_params(identity_jones(N, cdtype))
     p_bands = [
@@ -119,6 +127,15 @@ def _run_minibatch(cfg: RunConfig, log, audit):
         K = nchunk_max * 8 * N
         Z = jnp.zeros((M, cfg.npoly, K), dtype)
         Y_bands = [jnp.zeros_like(p_bands[0]) for _ in bands]
+        # the async state: per-band stored Gram terms + ages + the
+        # global round counter (persists ACROSS minibatches so the
+        # refresh schedule is one deterministic sequence; checkpointed
+        # whole, so --resume replays it exactly)
+        from sagecal_tpu.parallel.async_consensus import (
+            StalenessLedger, band_active, refresh_periods,
+        )
+
+        ledger = StalenessLedger(len(bands), (M, cfg.npoly, K), dtype)
 
     # minibatch time ranges
     ntime = meta.ntime
@@ -191,6 +208,8 @@ def _run_minibatch(cfg: RunConfig, log, audit):
             bands=cfg.bands, epochs=cfg.epochs, minibatches=nb,
             admm_iters=cfg.admm_iters, npoly=cfg.npoly,
             poly_type=cfg.poly_type, admm_rho=cfg.admm_rho,
+            consensus_staleness=cfg.consensus_staleness,
+            consensus_staleness_discount=cfg.consensus_staleness_discount,
             solver_mode=cfg.solver_mode, max_lbfgs=cfg.max_lbfgs,
             lbfgs_m=cfg.lbfgs_m, nulow=cfg.nulow, nuhigh=cfg.nuhigh,
             use_f64=cfg.use_f64, in_column=cfg.in_column,
@@ -211,6 +230,13 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                     Z = jnp.asarray(rarrs["Z"], dtype)
                     Y_bands = [jnp.asarray(a, dtype)
                                for a in rarrs["Y_bands"]]
+                    if StalenessLedger.present(rarrs):
+                        # async runs: the staleness ledger (stored Gram
+                        # terms + ages + round counter) is part of the
+                        # trajectory — restore it so the refresh
+                        # schedule continues where the killed run was
+                        ledger = StalenessLedger.from_arrays(
+                            rarrs, dtype=dtype)
                 # LBFGS curvature memory (guarded per band: absent in
                 # checkpoints from older builds, and a band that never
                 # solved has none) — restoring it is what makes the
@@ -281,14 +307,32 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                 # when tracing is on keeps the traced timings honest and
                 # the untraced path's dispatch pipelining untouched
                 band_secs = [0.0] * len(bands)
+                # deterministic refresh periods from this minibatch's
+                # unflagged-row counts (the straggler signal itself):
+                # heavy bands refresh less often under a staleness
+                # bound, so a round stops tracking the slowest band;
+                # K=0 -> all-ones periods -> the synchronous loop
+                band_rows = [float(jnp.sum(db.mask)) for db in dbs]
+                periods = refresh_periods(band_rows, K_stale)
+                if async_mode and elog is not None:
+                    elog.emit("async_schedule", epoch=epoch, minibatch=mb,
+                              staleness=K_stale, discount=sdisc,
+                              periods=[int(x) for x in periods],
+                              band_rows=band_rows,
+                              round_index=ledger.round_index)
                 for admm in range(cfg.admm_iters):
                     Z_old = Z
-                    zacc = jnp.zeros((M, cfg.npoly, nchunk_max * 8 * N), dtype)
+                    active = band_active(ledger.round_index, periods)
+                    # a band with no stored Gram term yet must solve
+                    # (cold start / first visit) — starvation-free
+                    active = active | (ledger.ages < 0)
                     round_span = tracer.span("admm.round",
                                              kind="admm_round", round=admm,
                                              epoch=epoch, minibatch=mb)
                     round_span.__enter__()
                     for bi in range(len(bands)):
+                        if not active[bi]:
+                            continue
                         BZ = consensus.bz_for_freq(
                             Z, jnp.asarray(B[bi], dtype)
                         ).reshape(M, nchunk_max, 8 * N)
@@ -307,12 +351,46 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                             band_secs[bi] += time.perf_counter() - t_band
                         p_bands[bi], mem_bands[bi] = p1, mem1
                         Yhat = Y_bands[bi] + rho[bi][:, None, None] * p1
-                        zacc = zacc + consensus.accumulate_z_term(
+                        ledger.record(bi, consensus.accumulate_z_term(
                             jnp.asarray(B[bi], dtype),
                             Yhat.reshape(M, -1),
-                        )
-                    Z = consensus.update_global_z(zacc, Bii)
+                        ))
+                    # Z solve over EVERY band's freshest stored term,
+                    # rho-discounted by age (discount**age, dropped
+                    # beyond the bound); all-fresh weights are exactly
+                    # 1 so the synchronous case reuses the precomputed
+                    # Bii and stays bit-identical to the classic loop
+                    ages_eff = np.where(active, 0, ledger.ages)
+                    w_z = np.where(ages_eff < 0, 0.0,
+                                   sdisc ** np.maximum(ages_eff, 0))
+                    if K_stale > 0:
+                        w_z = np.where(ages_eff > K_stale, 0.0, w_z)
+                    if not np.any(w_z > 0):
+                        w_z = np.ones_like(w_z)
+                    zacc = jnp.zeros((M, cfg.npoly, nchunk_max * 8 * N),
+                                     dtype)
                     for bi in range(len(bands)):
+                        if w_z[bi] == 0.0:
+                            continue
+                        term = jnp.asarray(ledger.zterms[bi], dtype)
+                        if w_z[bi] != 1.0:
+                            term = jnp.asarray(w_z[bi], dtype) * term
+                        zacc = zacc + term
+                    if np.all(w_z == 1.0):
+                        Bii_r = Bii
+                    else:
+                        Bii_r = consensus.find_prod_inverse_full(
+                            jnp.asarray(B, dtype),
+                            jnp.asarray(w_z, dtype)[:, None] * rho,
+                        )
+                    Z = consensus.update_global_z(zacc, Bii_r)
+                    for bi in range(len(bands)):
+                        if not active[bi]:
+                            # an idle band keeps its dual: it did not
+                            # re-solve against this round's Z, so a
+                            # dual ascent step here would double-count
+                            # its stale contribution
+                            continue
                         BZ1 = consensus.bz_for_freq(
                             Z, jnp.asarray(B[bi], dtype)
                         ).reshape(M, nchunk_max, 8 * N)
@@ -320,6 +398,7 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                             Y_bands[bi]
                             + rho[bi][:, None, None] * (p_bands[bi] - BZ1)
                         )
+                    ledger.advance()
                     round_span.__exit__(None, None, None)
                     if track:
                         # per-band scaled primal residuals (the same
@@ -389,7 +468,12 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                     pr = np.asarray(pres_traj)
                     du = np.tile(np.asarray(dual_traj)[:, None],
                                  (1, pr.shape[1]))
-                    verdict, reasons, health = assess_consensus(pr, du)
+                    verdict, reasons, health = assess_consensus(
+                        pr, du,
+                        ages=(np.maximum(ledger.ages, 0)
+                              if async_mode else None),
+                        staleness=(K_stale if async_mode else None),
+                    )
                     if elog is not None:
                         elog.emit(
                             "consensus_health", epoch=epoch, minibatch=mb,
@@ -422,6 +506,11 @@ def _run_minibatch(cfg: RunConfig, log, audit):
                     arrs["Z"] = np.asarray(Z)
                     arrs["Y_bands"] = np.stack(
                         [np.asarray(y) for y in Y_bands])
+                    if async_mode:
+                        # ages + stored Gram terms + round counter: the
+                        # complete async trajectory state, so --resume
+                        # replays the exact refresh schedule
+                        arrs.update(ledger.to_arrays())
                 for bi, mem in enumerate(mem_bands):
                     if mem is not None:
                         arrs.update(flatten_state(f"mem{bi}", mem))

@@ -9,6 +9,17 @@ Format contract (schema v1): one ``.npz`` per checkpoint holding
 - every other entry: one named solver-state array (gain bundles ``p``,
   ADMM ``Z``/``Y`` duals, ``rho``, trajectories).
 
+Bounded-staleness ledger contract: an async consensus run
+(``--consensus-staleness`` > 0 or a discount != 1, see
+``parallel/async_consensus.py``) additionally stores ``ledger.ages``
+(per-band rounds-since-refresh, -1 = never seen), ``ledger.zterms``
+(the stored per-band Gram numerator terms) and ``ledger.round`` (the
+global round counter).  These three arrays plus Z/Y ARE the complete
+async trajectory state: a resume that restores them replays the exact
+deterministic refresh schedule, so ``--resume`` stays bit-exact in
+async mode too.  Checkpoints from sync runs simply omit the keys
+(``StalenessLedger.present`` guards the restore).
+
 Writes are crash-consistent: the payload goes to a temp file in the
 checkpoint directory, is ``fsync``\\ ed, then ``os.replace``\\ d into
 place (the same pattern as obs/flight.py heartbeats, plus the fsync the

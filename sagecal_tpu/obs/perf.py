@@ -237,6 +237,131 @@ def _cost_analysis(compiled) -> Tuple[Optional[float], Optional[float]]:
         return None, None
 
 
+_COLLECTIVE_RE = None
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+
+def _hlo_shape_bytes(shapes: str) -> int:
+    import re
+
+    total = 0
+    for m in re.finditer(r"(\w+)\[([\d,]*)\]", shapes):
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(m.group(1), 4)
+    return total
+
+
+def collective_cost_analysis(compiled) -> dict:
+    """Static cross-device communication analysis of a compiled HLO.
+
+    Parses ``compiled.as_text()`` and attributes every collective op
+    (all-reduce / all-gather / all-to-all / reduce-scatter /
+    collective-permute) by its OUTPUT bytes to either the steady-state
+    round loop — any computation reachable from a ``while`` op's body —
+    or one-time setup/teardown.  ``collective_bytes_per_round`` is the
+    per-device bytes a single iteration of the round loop moves through
+    collectives: the honest comms floor the transpose-reduced consensus
+    z-step exists to shrink (each op is counted once per round; the mesh
+    ADMM keeps its collectives out of nested inner loops).
+
+    Returns ``{}`` when no HLO text is available (e.g. a backend without
+    ``as_text``), otherwise::
+
+        {"collective_bytes_total":     sum over every collective op,
+         "collective_bytes_per_round": sum inside while-body-reachable
+                                       computations,
+         "collective_ops_per_round":   op count in the round loop,
+         "collective_breakdown":       {op_kind: per-round bytes}}
+    """
+    import re
+
+    try:
+        txt = compiled.as_text()
+    except Exception:
+        return {}
+    if not isinstance(txt, str) or not txt:
+        return {}
+    comp_head = re.compile(
+        r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->\s*\S.*\{\s*$"
+    )
+    coll_re = re.compile(
+        r"=\s*\(?([^)=]*?)\)?\s*"
+        r"(all-reduce|all-gather|all-to-all|reduce-scatter|"
+        r"collective-permute)(-start)?\("
+    )
+    ref_re = re.compile(
+        r"(?:condition|body|to_apply|calls|update_computation|select|"
+        r"scatter)=%?([\w.\-]+)"
+    )
+    ref_set_re = re.compile(
+        r"(?:called_computations|branch_computations)=\{([^}]*)\}"
+    )
+    while_re = re.compile(r"=\s*\(?[^)=]*\)?\s*while\(")
+    colls: Dict[str, list] = {}
+    refs: Dict[str, set] = {}
+    while_bodies: set = set()
+    cur = None
+    for raw in txt.splitlines():
+        line = raw.strip()
+        m = comp_head.match(raw) or comp_head.match(line)
+        if m:
+            cur = m.group(1)
+            colls.setdefault(cur, [])
+            refs.setdefault(cur, set())
+            continue
+        if cur is None:
+            continue
+        cm = coll_re.search(line)
+        if cm:  # "-done" halves of async pairs don't match the regex
+            colls[cur].append(
+                (cm.group(2), _hlo_shape_bytes(cm.group(1)))
+            )
+        names = set(ref_re.findall(line))
+        for grp in ref_set_re.findall(line):
+            names.update(
+                n.strip().lstrip("%") for n in grp.split(",") if n.strip()
+            )
+        refs[cur].update(names)
+        if while_re.search(line):
+            wm = re.search(r"body=%?([\w.\-]+)", line)
+            if wm:
+                while_bodies.add(wm.group(1))
+    # computations reachable from any while body run once per round
+    reach: set = set()
+    stack = [b for b in while_bodies if b in colls]
+    while stack:
+        c = stack.pop()
+        if c in reach:
+            continue
+        reach.add(c)
+        stack.extend(r for r in refs.get(c, ()) if r in colls)
+    per_round = 0
+    nops = 0
+    breakdown: Dict[str, float] = {}
+    total = 0
+    for c, items in colls.items():
+        for op, b in items:
+            total += b
+            if c in reach:
+                per_round += b
+                nops += 1
+                breakdown[op] = breakdown.get(op, 0.0) + b
+    return {
+        "collective_bytes_total": float(total),
+        "collective_bytes_per_round": float(per_round),
+        "collective_ops_per_round": int(nops),
+        "collective_breakdown": breakdown,
+    }
+
+
 # -------------------------------------------------------- instrumented_jit
 
 
@@ -766,11 +891,13 @@ GATE_HIGHER_BETTER = (
     "mfu_vs_v5e_bf16_peak", "bw_util_vs_v5e_819gbps",
     "warm_start_speedup", "coh_bf16_iters_per_sec",
     "solves_per_sec_per_chip", "serve_batch_speedup",
+    "admm_collective_bytes_reduction",
 )
 GATE_LOWER_BETTER = (
     "xla_cost_analysis_bytes_accessed", "peak_device_memory_bytes",
     "compile_seconds_total", "coh_bf16_xla_cost_analysis_bytes_accessed",
-    "serve_p50_latency_s",
+    "serve_p50_latency_s", "admm_collective_bytes_per_round",
+    "admm_straggler_ratio",
 )
 # the metrics gated when present in BOTH records (others opt in via
 # --metric name=tol)
@@ -779,6 +906,7 @@ GATE_DEFAULT_METRICS = (
     "warm_start_speedup", "coh_bf16_iters_per_sec",
     "coh_bf16_xla_cost_analysis_bytes_accessed",
     "solves_per_sec_per_chip", "serve_batch_speedup", "serve_p50_latency_s",
+    "admm_collective_bytes_per_round", "admm_collective_bytes_reduction",
 )
 GATE_DEFAULT_TOLERANCE = 0.10
 

@@ -139,6 +139,8 @@ def assess_consensus(
     primal_res_band,
     dual_res_band,
     trend_thresh: float = CONSENSUS_TREND_THRESH,
+    ages=None,
+    staleness: Optional[int] = None,
 ) -> Tuple[str, List[str], dict]:
     """ADMM watchdog: per-band health from the (nadmm, Nf) residual
     trajectories (the arrays distributed runs attach to ``admm_round``
@@ -146,13 +148,22 @@ def assess_consensus(
     the per-band ``ratio`` / ``trend`` / ``diverged`` arrays of
     :func:`sagecal_tpu.parallel.consensus.consensus_health` (the shared
     definition — imported lazily so this module stays jax-free until an
-    ADMM run actually uses it)."""
+    ADMM run actually uses it).
+
+    ``ages`` / ``staleness``: the bounded-staleness run's final ledger
+    ages and bound (``--consensus-staleness``).  A band solving on
+    K-round-old consensus targets legitimately tracks its trajectory
+    minimum more loosely, so the trend threshold relaxes by
+    ``(1 + age)`` per band, while a STARVED band (age beyond the bound,
+    dropped from the Z solve) is divergence outright — both criteria
+    live in ``consensus_health``."""
     from sagecal_tpu.parallel.consensus import consensus_health
 
     pr = np.atleast_2d(np.asarray(primal_res_band, float))
     du = np.atleast_2d(np.asarray(dual_res_band, float))
     ratio, trend, diverged = (
-        np.asarray(x) for x in consensus_health(pr, du, trend_thresh)
+        np.asarray(x) for x in consensus_health(
+            pr, du, trend_thresh, ages=ages, staleness=staleness)
     )
     health = {"ratio": ratio, "trend": trend, "diverged": diverged}
     reasons: List[str] = []

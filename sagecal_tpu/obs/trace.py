@@ -527,7 +527,17 @@ def straggler_stats(band_seconds: Sequence[float],
     band, and a detection verdict at ``ratio_thresh`` (default from
     :func:`straggler_ratio_threshold`).  Delegates the array math to
     :func:`sagecal_tpu.parallel.consensus.band_imbalance` so the
-    definition lives next to the other consensus health metrics."""
+    definition lives next to the other consensus health metrics.
+
+    Reading the straggler table under bounded staleness (the
+    ``--consensus-staleness`` async rounds of
+    ``parallel/async_consensus.py``): a heavy band refreshing every
+    ``p`` rounds bills its solve time to 1-in-``p`` rounds, so its
+    per-round attributed seconds — and hence this ratio — drop by
+    ~``p``x relative to the synchronous schedule.  A PERSISTENT high
+    ratio in async mode therefore means the refresh periods no longer
+    match the actual skew (e.g. flag fractions drifted since the
+    periods were derived) rather than an unscheduled slow band."""
     if ratio_thresh is None:
         ratio_thresh = straggler_ratio_threshold()
     secs = [float(x) for x in band_seconds]

@@ -24,6 +24,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from sagecal_tpu.core.types import VisData
 from sagecal_tpu.solvers.lm import LMConfig, _residual_flat, lm_solve
@@ -67,6 +68,7 @@ def admm_sagefit(
     nulow: float = 2.0,
     nuhigh: float = 30.0,
     collect_trace: bool = False,
+    cluster_slice=None,
 ) -> AdmmLocalResult:
     """One worker's ADMM x-update for one tile.
 
@@ -82,6 +84,11 @@ def admm_sagefit(
         the residual at the incoming solution (the robust ADMM path's
         E-step); robust RTR/NSD modes run their own nu EM instead.
       solver_mode: SM_* dispatch (see module docstring).
+      cluster_slice: optional ``(start, count)`` fine-grained factor
+        node — only the ``count`` clusters from (dynamic) ``start`` are
+        re-solved and dual-coupled this pass; the rest stay fixed but
+        remain subtracted from the residual (em_residual_scan).  Only
+        the sliced rows of Y/BZ/rho are read.
     """
     F, rows = data.vis.shape[-3], data.vis.shape[-1]
     nreal = rows * F * 8
@@ -158,7 +165,8 @@ def admm_sagefit(
     p = p0
     traces = []
     for _ in range(max_emiter):
-        p, tr = em_residual_scan(data, cdata, p, (Y, BZ, rho), solve_one)
+        p, tr = em_residual_scan(data, cdata, p, (Y, BZ, rho), solve_one,
+                                 cluster_slice=cluster_slice)
         if collect_trace:
             traces.append(tr)
 
@@ -177,7 +185,8 @@ def admm_dual_update(Y, p, BZ, rho):
 
 
 def round_work_weights(nadmm: int, nslots: int, plain_emiter: int = 2,
-                       max_emiter: int = 1):
+                       max_emiter: int = 1, slot_rows=None,
+                       cluster_groups: int = 1):
     """Static per-ADMM-round work model (host-side, plain floats).
 
     The mesh ADMM runs its whole nadmm loop as one jitted program, so
@@ -189,8 +198,98 @@ def round_work_weights(nadmm: int, nslots: int, plain_emiter: int = 2,
     Sbegin/Scurrent/Send rotation — see parallel/mesh.py).  Returns
     ``nadmm`` positive weights proportional to modeled solver work;
     the z-step psum is negligible next to the x-steps (PAPERS.md,
-    "Unwrapping ADMM")."""
+    "Unwrapping ADMM").
+
+    ``slot_rows``: optional per-slot UNFLAGGED-row counts (or any
+    per-slot work proxy, e.g. ``nrows * fratio``).  Without it every
+    slot is assumed to carry the same rows — exactly the uniformity
+    that flag-skewed bands break, and that the synthetic band
+    attribution would otherwise paper over: a round's solver work is
+    dominated by its active slot's unflagged data, so round r >= 1 is
+    weighted by slot ``(r-1) % nslots``'s rows (normalized to a mean of
+    1 so the uniform case is unchanged) and round 0 by their sum.
+
+    ``cluster_groups``: fine-grained consensus decomposition — rounds
+    solve 1/cluster_groups of the clusters, so per-round x-step work
+    shrinks accordingly (the group rotation is the fast axis:
+    round r >= 1 is slot ``((r-1)//cluster_groups) % nslots``).
+    """
     if nadmm <= 0:
         return []
-    w0 = float(max(nslots, 1) * max(plain_emiter, 1))
-    return [w0] + [float(max(max_emiter, 1))] * (nadmm - 1)
+    nslots = max(nslots, 1)
+    if slot_rows is not None and len(slot_rows) and sum(slot_rows) > 0:
+        mean = float(sum(slot_rows)) / len(slot_rows)
+        rel = [float(r) / mean for r in slot_rows]
+        # fold multi-band-per-slot groupings down to nslots entries
+        if len(rel) != nslots:
+            per = max(len(rel) // nslots, 1)
+            rel = [sum(rel[s * per:(s + 1) * per]) / per
+                   for s in range(nslots)]
+    else:
+        rel = [1.0] * nslots
+    cg = max(cluster_groups, 1)
+    w0 = float(sum(rel) * max(plain_emiter, 1))
+    ws = [w0]
+    for r in range(1, nadmm):
+        s = ((r - 1) // cg) % nslots
+        ws.append(float(max(max_emiter, 1)) * rel[s] / cg)
+    return ws
+
+
+def factor_schedule(nadmm: int, nslots: int, cluster_groups: int = 1,
+                    band_weights=None, ndev: int = 1):
+    """Host-built static (slot, cluster-group) schedule for the mesh
+    ADMM's fine-grained rounds (parallel/mesh.py ConsensusConfig).
+
+    Returns ``(slot_sched, group_sched)`` int arrays of shape
+    ``(nadmm-1, ndev)``: round r's active sub-band slot and cluster
+    group per mesh device.  The default (no ``band_weights``) is the
+    uniform rotation — groups fastest, then the Sbegin/Scurrent/Send
+    slot rotation — identical on every device.
+
+    ``band_weights``: per-BAND unflagged-row counts, length
+    ``nslots * ndev`` with band ``d * nslots + s`` on device d (the
+    contiguous sharding of parallel/mesh.py).  When given, each device
+    allocates its slot visits proportionally to ITS bands' weights
+    (largest-remainder apportionment over the nadmm-1 rounds) — the
+    shard_map-level rebalancing: a device whose heavy band carries 3x
+    the rows of its light band visits the heavy slot ~3x as often, so
+    flag-skewed bands stop starving while dead slots stop billing
+    rounds.  Group rotation stays the fast axis within each device's
+    visit sequence.
+    """
+    nrounds = max(nadmm - 1, 0)
+    cg = max(cluster_groups, 1)
+    nslots = max(nslots, 1)
+    slot_sched = np.zeros((nrounds, ndev), np.int32)
+    group_sched = np.zeros((nrounds, ndev), np.int32)
+    for r in range(nrounds):
+        group_sched[r, :] = r % cg
+    if band_weights is None:
+        for r in range(nrounds):
+            slot_sched[r, :] = (r // cg) % nslots
+        return slot_sched, group_sched
+    w = np.asarray(band_weights, float).reshape(ndev, nslots)
+    w = np.maximum(w, 1e-12)
+    nvisits = (nrounds + cg - 1) // cg
+    for d in range(ndev):
+        share = w[d] / w[d].sum() * nvisits
+        counts = np.floor(share).astype(int)
+        rem = share - counts
+        for s in np.argsort(-rem)[: nvisits - counts.sum()]:
+            counts[s] += 1
+        counts = np.maximum(counts, 1 if nvisits >= nslots else 0)
+        # interleave visits (round-robin over remaining budget) so a
+        # heavy slot's extra visits spread across the run
+        visits = []
+        left = counts.copy()
+        while len(visits) < nvisits:
+            for s in range(nslots):
+                if left[s] > 0:
+                    visits.append(s)
+                    left[s] -= 1
+            if left.sum() <= 0 and len(visits) < nvisits:
+                visits.extend([int(np.argmax(w[d]))] * (nvisits - len(visits)))
+        for r in range(nrounds):
+            slot_sched[r, d] = visits[r // cg]
+    return slot_sched, group_sched

@@ -1,0 +1,155 @@
+"""Bounded-staleness consensus rounds for the host-driven band ADMM.
+
+The minibatch consensus loop (``apps/minibatch.py``) and the async
+smoke in ``__graft_entry__.py`` run their band x-steps sequentially on
+the host, so a flag-skewed band makes every synchronous round as
+expensive as its heaviest member.  This module implements the
+asynchronous alternative from "Asynchronous distributed ADMM"-style
+bounded staleness (see PAPERS.md, arXiv:1603.02526 fine-grained
+decomposition + the transpose-reduction Gram objects of
+arXiv:1504.02147): each band refreshes its basis-sized Gram
+contribution ``B_f^T (Y_f + rho_f J_f)`` on its own deterministic
+period, the Z solve consumes the freshest stored term of EVERY band
+with a ``discount**age`` rho-weighting, and a band's term older than
+``staleness`` rounds drops out of the solve entirely (it is starved —
+the watchdog criterion in :func:`consensus.consensus_health`).
+
+Determinism is the design center: refresh periods are a pure function
+of the per-band work weights and the staleness bound, the round counter
+advances by one per consensus round, and the whole ledger (ages +
+stored Gram terms + counter) serializes to flat arrays — so an elastic
+checkpoint carries it and ``--resume`` replays the exact same
+refresh schedule (tests/test_async_consensus.py).
+
+``staleness = 0`` degenerates to periods of all-ones: every band
+refreshes every round and the trajectory is bit-identical to the
+synchronous loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def refresh_periods(band_weights: Sequence[float],
+                    staleness: int) -> np.ndarray:
+    """Deterministic per-band refresh periods from work weights.
+
+    ``band_weights``: per-band work proxies (unflagged-row counts — the
+    same quantity :func:`sagecal_tpu.parallel.admm.round_work_weights`
+    wants as ``slot_rows``).  The LIGHTEST band sets the unit of round
+    work and refreshes every round; a band carrying ``k`` times that
+    work refreshes every ``round(k)`` rounds so its average per-round
+    cost matches the light bands' — capped at ``staleness + 1`` so its
+    stored Gram term is never older than the bound when it is consumed.
+    ``staleness <= 0`` returns all-ones (the synchronous schedule).
+    """
+    w = np.asarray([max(float(x), 0.0) for x in band_weights], float)
+    n = w.size
+    if n == 0:
+        return np.zeros((0,), np.int64)
+    if staleness <= 0:
+        return np.ones((n,), np.int64)
+    pos = w[w > 0]
+    unit = float(pos.min()) if pos.size else 1.0
+    rel = np.where(w > 0, w / max(unit, 1e-30), 1.0)
+    per = np.clip(np.rint(rel).astype(np.int64), 1, int(staleness) + 1)
+    return per
+
+
+def band_active(round_index: int, periods: np.ndarray) -> np.ndarray:
+    """Which bands refresh in consensus round ``round_index`` (bool,
+    per band).  Offsets are staggered by band index so same-period
+    bands don't all land on the same round."""
+    per = np.asarray(periods, np.int64)
+    idx = np.arange(per.size)
+    return (round_index % per) == (idx % per)
+
+
+class StalenessLedger:
+    """Ages + stored Gram terms of an async consensus run.
+
+    ``ages[b]`` is how many rounds ago band ``b`` last refreshed its
+    stored numerator term ``zterms[b]`` (shape (M, Npoly, K) each).  A
+    band that has never contributed has age -1 and a zero term; both
+    are excluded from the Z solve.  The ledger (plus the round counter)
+    is the complete async state: checkpointing ``to_arrays()`` and
+    restoring with ``from_arrays()`` resumes the exact trajectory.
+    """
+
+    def __init__(self, nbands: int, zshape, dtype, round_index: int = 0):
+        self.ages = np.full((nbands,), -1, np.int64)
+        self.zterms = np.zeros((nbands,) + tuple(zshape), dtype)
+        self.round_index = int(round_index)
+
+    def record(self, band: int, zterm) -> None:
+        """Band ``band`` refreshed this round: store its fresh term."""
+        self.zterms[band] = np.asarray(zterm)
+        self.ages[band] = 0
+
+    def advance(self) -> None:
+        """Close the round: every previously-seen term ages by one."""
+        self.ages = np.where(self.ages >= 0, self.ages + 1, self.ages)
+        self.round_index += 1
+
+    def weights(self, staleness: Optional[int],
+                discount: float = 1.0) -> np.ndarray:
+        """Per-band Z-solve weights: ``discount**age`` within the bound,
+        0 for never-seen or over-age terms (the rho-discount of
+        :func:`consensus.staleness_weights`, with age counted from the
+        stored term's refresh round)."""
+        ages = np.maximum(self.ages, 0)
+        w = np.asarray(discount, float) ** ages
+        w = np.where(self.ages < 0, 0.0, w)
+        if staleness is not None:
+            w = np.where(ages > int(staleness), 0.0, w)
+        return w
+
+    # ------------------------------------------------- checkpoint I/O
+
+    def to_arrays(self, prefix: str = "ledger") -> dict:
+        return {
+            f"{prefix}.ages": self.ages.copy(),
+            f"{prefix}.zterms": self.zterms.copy(),
+            f"{prefix}.round": np.asarray([self.round_index], np.int64),
+        }
+
+    @classmethod
+    def from_arrays(cls, arrs: dict, prefix: str = "ledger",
+                    dtype=None) -> "StalenessLedger":
+        z = np.asarray(arrs[f"{prefix}.zterms"])
+        led = cls(z.shape[0], z.shape[1:], dtype or z.dtype,
+                  round_index=int(np.asarray(arrs[f"{prefix}.round"])[0]))
+        led.zterms = z.astype(dtype) if dtype is not None else z.copy()
+        led.ages = np.asarray(arrs[f"{prefix}.ages"], np.int64).copy()
+        return led
+
+    @staticmethod
+    def present(arrs: dict, prefix: str = "ledger") -> bool:
+        return f"{prefix}.zterms" in arrs
+
+
+def stale_weighted_z(ledger: StalenessLedger, B, rho, weights):
+    """The rho-discounted Z solve over the ledger's stored Gram terms.
+
+    num = sum_f w_f zterm_f,  P_m = sum_f w_f rho[f,m] B_f B_f^T,
+    Z = pinv(P) num — exactly the synchronous
+    :func:`consensus.update_global_z` when every weight is 1 and every
+    term is fresh.  ``B`` (Nf, Npoly), ``rho`` (Nf, M), ``weights``
+    (Nf,) from :meth:`StalenessLedger.weights`.  Falls back to the
+    unweighted solve when every band is starved (all weights 0) so the
+    consensus never collapses to a zero division.
+    """
+    import jax.numpy as jnp
+
+    from sagecal_tpu.parallel import consensus
+
+    w = np.asarray(weights, float)
+    if not np.any(w > 0):
+        w = np.ones_like(w)
+    wj = jnp.asarray(w, B.dtype)
+    num = jnp.einsum("f,fmpk->mpk", wj, jnp.asarray(ledger.zterms, B.dtype))
+    Bii = consensus.find_prod_inverse_full(B, wj[:, None] * rho)
+    return consensus.update_global_z(num, Bii)

@@ -17,6 +17,9 @@ Conventions:
 
 from __future__ import annotations
 
+import dataclasses
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -26,6 +29,67 @@ POLY_ORDINARY = 0
 POLY_NORMALIZED = 1
 POLY_BERNSTEIN = 2
 POLY_RATIONAL = 3  # [1, (f-f0)/f0, (f0/f-1), ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsensusConfig:
+    """Static configuration of the consensus (Z-step) layer.
+
+    ``zstep``:
+      "grouped"  — the classic replicated z-step: every device psums the
+        full basis-sized numerator (M, Npoly, K) and solves Z locally
+        (parallel/mesh._zstep_grouped).
+      "reduced"  — transpose-reduced scattered z-step ("Unwrapping ADMM",
+        arXiv:1504.02147): the Gram numerator is ``psum_scatter``-ed over
+        the solution axis so each device holds only a K/ndev shard of Z,
+        the global solve is a tiny local einsum on the shard, and the
+        active band's consensus target B_f Z comes back through a single
+        ``all_to_all`` — per-round collective bytes drop from
+        ~Npoly*M*K to ~(Npoly/ndev + M_active/M)*M*K.
+
+    ``cluster_groups``: fine-grained consensus decomposition
+      (arXiv:1603.02526) — the per-band x-step is split below band
+      granularity into this many cluster factor groups, each round
+      solving one (band slot, cluster group) factor node with its own
+      duals.  Communication and x-step work per round scale with
+      M/cluster_groups.  1 = classic whole-band rounds.
+
+    ``staleness``: bounded-staleness rounds — contributions older than
+      this many rounds are dropped from the Z solve (mesh mode: slots of
+      the Scurrent rotation whose stored Yhat is older than K rounds).
+      ``None`` = unbounded (the reference's multiplexed semantics).
+
+    ``staleness_discount``: rho-discount applied per round of age to a
+      stale band's Gram contribution (both the numerator term and its
+      rho in the denominator), so a Z solve leans on fresh bands:
+      weight = discount**age.  1.0 = no discounting.
+
+    ``slot_schedule`` / ``group_schedule``: optional host-built static
+      schedules (see :func:`sagecal_tpu.parallel.admm.factor_schedule`)
+      of shape (nadmm-1,) or (nadmm-1, ndev) — per-round active slot /
+      cluster group, optionally per mesh device (shard_map-level
+      rebalancing: devices whose bands carry more unflagged rows get
+      proportionally more visits).  ``None`` = the uniform
+      Sbegin/Scurrent/Send rotation.
+    """
+
+    zstep: str = "grouped"
+    cluster_groups: int = 1
+    staleness: Optional[int] = None
+    staleness_discount: float = 1.0
+    slot_schedule: Optional[np.ndarray] = None
+    group_schedule: Optional[np.ndarray] = None
+
+    @property
+    def is_default(self) -> bool:
+        return (
+            self.zstep == "grouped"
+            and self.cluster_groups == 1
+            and self.staleness is None
+            and self.staleness_discount == 1.0
+            and self.slot_schedule is None
+            and self.group_schedule is None
+        )
 
 
 def setup_polynomials(freqs, f0: float, Npoly: int, ptype: int = POLY_BERNSTEIN):
@@ -117,7 +181,8 @@ def bz_for_freq(Z, B_f):
     return jnp.einsum("p,mpk->mk", B_f, Z)
 
 
-def update_rho_bb(rho, rho_upper, dY, dJ, eps: float = 1e-12):
+def update_rho_bb(rho, rho_upper, dY, dJ, eps: float = 1e-12,
+                  dj_floor: float = 1e-6):
     """Barzilai-Borwein adaptive penalty update, per cluster.
 
     ``update_rho_bb`` (consensus_poly.c:860-911): with deltaY = Yhat -
@@ -127,6 +192,15 @@ def update_rho_bb(rho, rho_upper, dY, dJ, eps: float = 1e-12):
     only under sufficient correlation (>0.2) and 0.001 < alpha < upper.
 
     rho, rho_upper: (M,); dY, dJ: (M, K) per-cluster flattened deltas.
+
+    ``dj_floor``: per-element RMS floor on dJ below which the update is
+    rejected and rho kept.  On a CONVERGED cluster dJ -> 0 while dY
+    stays finite, so ``<dJ,dJ>`` passes the absolute ``eps`` check yet
+    alphaMG = <dY,dJ>/<dJ,dJ> blows up toward ``rho_upper`` — a huge
+    penalty jump on exactly the band that needed none, which
+    destabilizes late (and especially stale/async) rounds.  Gains are
+    O(1) normalized Jones params, so an absolute RMS floor is
+    scale-correct here.
     """
     ip12 = jnp.sum(dY * dJ, axis=-1)
     ip11 = jnp.sum(dY * dY, axis=-1)
@@ -136,15 +210,46 @@ def update_rho_bb(rho, rho_upper, dY, dJ, eps: float = 1e-12):
     alphaSD = ip11 / safe12
     alphaMG = ip12 / jnp.where(ip22 < eps, 1.0, ip22)
     alphahat = jnp.where(2.0 * alphaMG > alphaSD, alphaMG, alphaSD - 0.5 * alphaMG)
+    nk = jnp.asarray(dJ.shape[-1], ip22.dtype)
     ok = (
         (ip12 > eps)
         & (ip11 > eps)
         & (ip22 > eps)
+        & (ip22 > nk * (dj_floor * dj_floor))
         & (corr > 0.2)
         & (alphahat > 1e-3)
         & (alphahat < rho_upper)
     )
     return jnp.where(ok, alphahat, rho)
+
+
+def slot_staleness_ages(active_slot, nslots):
+    """Ages of every multiplexed slot's stored Yhat right after slot
+    ``active_slot`` refreshed: slot s was last active ``(active_slot -
+    s) mod nslots`` rounds ago (the Scurrent rotation of
+    sagecal_master.cpp:157-206).  Returns (nslots,) int ages."""
+    s = jnp.arange(nslots)
+    return jnp.mod(active_slot - s, nslots)
+
+
+def staleness_weights(ages, staleness=None, discount: float = 1.0,
+                      dtype=None):
+    """Per-contribution Z-solve weights from staleness ages.
+
+    ``weight = discount**age`` for contributions within the bound,
+    0 for contributions older than ``staleness`` rounds (``None`` =
+    unbounded).  Applied to BOTH the Gram numerator term B_f (Y_f +
+    rho_f J_f) and that band's rho in the denominator, this is exactly
+    a rho-discount: a stale band still pulls the consensus toward its
+    last solution, just with a proportionally weaker penalty.
+    """
+    ages = jnp.asarray(ages)
+    if dtype is None:
+        dtype = jnp.result_type(float)  # x64-aware default
+    w = jnp.asarray(discount, dtype) ** ages.astype(dtype)
+    if staleness is not None:
+        w = jnp.where(ages <= staleness, w, jnp.zeros_like(w))
+    return w
 
 
 def soft_threshold(z, lam):
@@ -165,6 +270,8 @@ def consensus_health(
     dual_res_band,
     trend_thresh: float = 2.0,
     eps: float = 1e-30,
+    ages=None,
+    staleness: Optional[int] = None,
 ):
     """Per-band ADMM consensus health from residual trajectories.
 
@@ -183,6 +290,16 @@ def consensus_health(
     - ``diverged``: non-finite residuals anywhere in the trajectory, or
       ``trend > trend_thresh`` (sustained growth, not a one-round blip).
 
+    Staleness-aware criterion (bounded-staleness rounds): ``ages`` is
+    the per-band age (rounds since last refresh) at the final round.  A
+    band whose contribution is ``a`` rounds stale is measured against a
+    Z that moved ``a`` rounds past its last solve, so its primal
+    residual legitimately rides above the fresh-band envelope; its
+    trend threshold is relaxed to ``trend_thresh * (1 + a)``.  A band
+    older than the configured ``staleness`` bound is STARVED — the
+    scheduler stopped refreshing it — and is flagged diverged outright
+    (its residual trajectory is no longer evidence of anything).
+
     Pure array math (works on numpy or jax inputs) so the apps' host-side
     watchdog and on-device callers share one definition.
     """
@@ -193,7 +310,14 @@ def consensus_health(
     nonfinite = ~(
         jnp.all(jnp.isfinite(pr), axis=0) & jnp.all(jnp.isfinite(du), axis=0)
     )
-    diverged = nonfinite | (trend > trend_thresh)
+    thresh = jnp.asarray(trend_thresh, trend.dtype)
+    if ages is not None:
+        a = jnp.asarray(ages).astype(trend.dtype)
+        thresh = thresh * (1.0 + a)
+    diverged = nonfinite | (trend > thresh)
+    if ages is not None and staleness is not None:
+        starved = jnp.asarray(ages) > staleness
+        diverged = diverged | starved
     return ratio, trend, diverged
 
 

@@ -56,7 +56,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from sagecal_tpu.core.types import VisData, jones_to_params, params_to_jones
 from sagecal_tpu.obs.perf import instrumented_jit
 from sagecal_tpu.parallel import consensus
-from sagecal_tpu.parallel.admm import admm_sagefit
+from sagecal_tpu.parallel.admm import admm_sagefit, factor_schedule
 from sagecal_tpu.parallel.manifold import manifold_average
 from sagecal_tpu.solvers.lm import LMConfig
 from sagecal_tpu.solvers.sage import SM_LM_LBFGS, ClusterData
@@ -122,21 +122,29 @@ def _unflat(x, nchunk, n8):
 
 
 def _zstep_grouped(Yhat_flat, rho, B_g, axis_name, federated_alpha=None,
-                   z_extra=None):
+                   z_extra=None, weights=None):
     """psum z accumulation + replicated Bii + Z update.
 
     Yhat_flat (G, M, K); rho (G, M); B_g (G, Npoly) — all local
     sub-bands contribute (vmapped accumulate, summed locally, then
     psum'd across the mesh).  ``z_extra``: optional replicated
     (M, Npoly, K) addition to the accumulated z (the spatial-reg
-    ``alpha Zbar - X`` term, sagecal_master.cpp:855-872)."""
-    z_local = jnp.sum(
-        jax.vmap(consensus.accumulate_z_term)(B_g, Yhat_flat), axis=0
-    )
+    ``alpha Zbar - X`` term, sagecal_master.cpp:855-872).
+    ``weights``: optional per-local-slot (G,) staleness discounts
+    applied to both the numerator terms and the rho denominator
+    (consensus.staleness_weights) — identical on every device since the
+    slot rotation is."""
+    terms = jax.vmap(consensus.accumulate_z_term)(B_g, Yhat_flat)
+    if weights is not None:
+        terms = weights[:, None, None, None] * terms
+    z_local = jnp.sum(terms, axis=0)
     z = jax.lax.psum(z_local, axis_name)
     if z_extra is not None:
         z = z + z_extra
-    P_term = jnp.einsum("gm,gp,gq->mpq", rho, B_g, B_g)
+    if weights is not None:
+        P_term = jnp.einsum("g,gm,gp,gq->mpq", weights, rho, B_g, B_g)
+    else:
+        P_term = jnp.einsum("gm,gp,gq->mpq", rho, B_g, B_g)
     P_sum = jax.lax.psum(P_term, axis_name)
     if federated_alpha is not None:
         Np = B_g.shape[-1]
@@ -180,6 +188,7 @@ def make_admm_mesh_fn(
     robust_nu: Optional[float] = None,
     spatial: Optional[SpatialConfig] = None,
     collect_trace: bool = False,
+    consensus_cfg: Optional[consensus.ConsensusConfig] = None,
 ):
     """Build the jitted mesh-wide ADMM calibration function.
 
@@ -212,19 +221,105 @@ def make_admm_mesh_fn(
     ``dual_res_band`` (nadmm, Nf), ``rho_trace`` (nadmm, Nf, M)); the
     Barzilai-Borwein penalty adaptation is exactly what these exist to
     monitor.  Off (default) the jitted signature is unchanged.
+
+    ``consensus_cfg``: optional :class:`sagecal_tpu.parallel.consensus.
+    ConsensusConfig` selecting the consensus round structure — the
+    transpose-reduced scattered z-step, fine-grained cluster factor
+    groups, per-device slot schedules, and in-mesh bounded-staleness
+    weighting.  ``None`` (default) keeps the classic grouped rounds and
+    emits the exact original program.
     """
 
-    def _fit(data, cdata, p, Y, BZ, rho_m, emiter):
+    ccfg = (consensus_cfg if consensus_cfg is not None
+            else consensus.ConsensusConfig())
+    if ccfg.zstep not in ("grouped", "reduced"):
+        raise ValueError(f"unknown zstep {ccfg.zstep!r}")
+    cg = max(int(ccfg.cluster_groups), 1)
+    fine = cg > 1
+    use_staleness = (
+        ccfg.staleness is not None or ccfg.staleness_discount != 1.0
+    )
+    if use_staleness and (fine or ccfg.slot_schedule is not None
+                          or ccfg.group_schedule is not None):
+        raise ValueError(
+            "in-mesh bounded staleness composes with the uniform "
+            "whole-band rotation only; fine-grained / rebalanced "
+            "staleness is the minibatch async-consensus path"
+        )
+    reduced = ccfg.zstep == "reduced"
+    if reduced and ccfg.group_schedule is not None:
+        gs = np.asarray(ccfg.group_schedule)
+        if gs.ndim == 2 and not np.all(gs == gs[:, :1]):
+            raise ValueError(
+                "reduced z-step needs a device-uniform group schedule "
+                "(the incremental Gram delta rows must align across "
+                "the mesh)"
+            )
+    # full Z is needed replicated every round for the spatial coupling
+    # and the per-band residual telemetry; there the reduced mode keeps
+    # the scattered solve but all_gathers Z back per round (still far
+    # below the grouped psum of the full numerator).
+    zmode = "grouped" if not reduced else (
+        "reduced_gather" if (spatial is not None or collect_trace)
+        else "reduced_scatter"
+    )
+    # with fixed rho, no staleness discounts and no federated alpha the
+    # Bii denominator never changes — hoist its psum out of the round
+    # loop entirely (the grouped path psums it every round).
+    den_static = (
+        reduced and not bb_rho and not use_staleness and spatial is None
+    )
+    have_sched = (
+        fine or ccfg.slot_schedule is not None
+        or ccfg.group_schedule is not None
+    )
+    ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+
+    def _fit(data, cdata, p, Y, BZ, rho_m, emiter, cluster_slice=None):
         return admm_sagefit(
             data, cdata, p, Y, BZ, rho_m,
             max_emiter=emiter, lm_config=lm_config,
             solver_mode=solver_mode, robust_nu=robust_nu,
+            cluster_slice=cluster_slice,
         )
 
     def local_loop(data: VisData, cdata: ClusterData, p0, rho, B_g):
         # all array leaves carry the local sub-band group axis G
         G, M, nchunk_max, n8 = p0.shape
+        K = nchunk_max * n8
+        Npoly = B_g.shape[-1]
         zeros_g = jnp.zeros_like(p0[0])
+        if M % cg != 0:
+            raise ValueError(
+                f"cluster_groups {cg} must divide the cluster count {M}"
+            )
+        Mg = M // cg
+        if reduced:
+            if K % ndev != 0:
+                raise ValueError(
+                    f"reduced z-step needs the solution size {K} "
+                    f"divisible by the mesh size {ndev}; use "
+                    "zstep='grouped'"
+                )
+            Ks = K // ndev
+        if have_sched:
+            # host-built static (slot, group) schedule, one column per
+            # mesh device (shard_map-level rebalancing)
+            slot_np, group_np = factor_schedule(
+                nadmm, G, cluster_groups=cg, ndev=ndev
+            )
+            if ccfg.slot_schedule is not None:
+                s = np.asarray(ccfg.slot_schedule, np.int32)
+                slot_np = np.broadcast_to(
+                    s[:, None] if s.ndim == 1 else s, (nadmm - 1, ndev)
+                )
+            if ccfg.group_schedule is not None:
+                s = np.asarray(ccfg.group_schedule, np.int32)
+                group_np = np.broadcast_to(
+                    s[:, None] if s.ndim == 1 else s, (nadmm - 1, ndev)
+                )
+            slot_arr = jnp.asarray(slot_np, jnp.int32)
+            group_arr = jnp.asarray(group_np, jnp.int32)
 
         # ---- admm 0: plain solve of every local slot -------------------
         def plain_one(_, inp):
@@ -240,15 +335,14 @@ def make_admm_mesh_fn(
             # (sagecal_master.cpp:826-838)
             jones = params_to_jones(p)  # (G, M, nchunk, N, 2, 2)
             gath = jax.lax.all_gather(jones, axis_name)  # (ndev, G, ...)
-            ndev, G_, Mm = gath.shape[0], gath.shape[1], gath.shape[2]
-            gflat = gath.reshape(ndev * G_, Mm, -1, 2, 2)
+            nd_, G_, Mm = gath.shape[0], gath.shape[1], gath.shape[2]
+            gflat = gath.reshape(nd_ * G_, Mm, -1, 2, 2)
             aligned = manifold_average(gflat, niter=20)
             idx = jax.lax.axis_index(axis_name)
-            own = aligned.reshape((ndev, G_) + aligned.shape[1:])[idx]
+            own = aligned.reshape((nd_, G_) + aligned.shape[1:])[idx]
             p = jones_to_params(own.reshape(jones.shape)).astype(p0.dtype)
 
         Yhat = rho[:, :, None, None] * p  # Y=0 so Yhat = rho*J
-        Z = _zstep_grouped(_flat(Yhat), rho, B_g, axis_name)
 
         use_spatial = spatial is not None
         if use_spatial:
@@ -302,6 +396,80 @@ def make_admm_mesh_fn(
                 consensus.bz_for_freq(Z_, B_g[g]), nchunk_max, n8
             )
 
+        # ---- round-0 consensus -----------------------------------------
+        if zmode == "grouped":
+            Z = _zstep_grouped(_flat(Yhat), rho, B_g, axis_name)
+        else:
+            # transpose reduction (arXiv:1504.02147): the basis-sized
+            # Gram numerator lives psum_scatter'd over the solution
+            # axis, so each device solves only its K/ndev shard of Z and
+            # per-round collectives carry Gram deltas, never full
+            # (M, Npoly, K) stacks.
+            B_full = jax.lax.all_gather(B_g, axis_name, axis=0,
+                                        tiled=True)
+
+            def _num_scatter(Yhat_flat, weights=None):
+                terms = jax.vmap(consensus.accumulate_z_term)(
+                    B_g, Yhat_flat
+                )
+                if weights is not None:
+                    terms = weights[:, None, None, None] * terms
+                z_local = jnp.sum(terms, axis=0)
+                return jax.lax.psum_scatter(
+                    z_local, axis_name, scatter_dimension=2, tiled=True
+                )
+
+            def _den_inv(rho_cur, weights=None, federated_alpha=None):
+                if weights is not None:
+                    P_term = jnp.einsum(
+                        "g,gm,gp,gq->mpq", weights, rho_cur, B_g, B_g
+                    )
+                else:
+                    P_term = jnp.einsum(
+                        "gm,gp,gq->mpq", rho_cur, B_g, B_g
+                    )
+                P_sum = jax.lax.psum(P_term, axis_name)
+                if federated_alpha is not None:
+                    P_sum = P_sum + federated_alpha[:, None, None] * \
+                        jnp.eye(Npoly, dtype=P_sum.dtype)[None]
+                return jnp.linalg.pinv(P_sum)
+
+            def a2a_bz(Zsh_, slot_row, group_row, g):
+                """Active consensus target B_f Z from the sharded Z:
+                every device computes the partial on ITS K-shard for
+                EVERY device's active (slot, group) factor, and one
+                all_to_all hands each device its own band's rows back
+                in shard order."""
+                if slot_row is None:
+                    band_ids = jnp.arange(ndev) * G + g
+                else:
+                    band_ids = jnp.arange(ndev) * G + slot_row
+                rows = B_full[band_ids]  # (ndev, Npoly)
+                if group_row is None:
+                    starts = jnp.zeros((ndev,), jnp.int32)
+                else:
+                    starts = (group_row * Mg).astype(jnp.int32)
+
+                def part(brow, st):
+                    blk = jax.lax.dynamic_slice(
+                        Zsh_, (st, jnp.int32(0), jnp.int32(0)),
+                        (Mg, Npoly, Ks),
+                    )
+                    return jnp.einsum("p,mpk->mk", brow, blk)
+
+                partials = jax.vmap(part)(rows, starts)  # (ndev,Mg,Ks)
+                got = jax.lax.all_to_all(
+                    partials, axis_name, split_axis=0, concat_axis=0,
+                    tiled=True,
+                )
+                bz = jnp.moveaxis(got, 0, 1).reshape(Mg, K)
+                return _unflat(bz, nchunk_max, n8)
+
+            num_shard = _num_scatter(_flat(Yhat))
+            Bii0 = _den_inv(rho)
+            Zsh = consensus.update_global_z(num_shard, Bii0)
+            Z = jax.lax.all_gather(Zsh, axis_name, axis=2, tiled=True)
+
         BZ_all = jax.vmap(lambda g: bz_of(Z, g))(jnp.arange(G))
         Y = Yhat - rho[:, :, None, None] * BZ_all
 
@@ -319,8 +487,32 @@ def make_admm_mesh_fn(
 
         # ---- admm > 0: rotate over local slots -------------------------
         def one_iter(carry, it):
-            p, Y, Z, rho, Yhat_all, Yhat_prev, p_prev, spstate = carry
-            g = (it - 1) % G  # active local slot (Scurrent rotation)
+            p, Y, Zc, rho, Yhat_all, Yhat_prev, p_prev, spstate = carry
+            if have_sched:
+                slot_row = jax.lax.dynamic_index_in_dim(
+                    slot_arr, it - 1, keepdims=False
+                )
+                group_row = jax.lax.dynamic_index_in_dim(
+                    group_arr, it - 1, keepdims=False
+                )
+                did = jax.lax.axis_index(axis_name)
+                g = slot_row[did]
+                c0 = group_row[did] * Mg
+            else:
+                slot_row = group_row = None
+                g = (it - 1) % G  # active local slot (Scurrent rotation)
+                c0 = 0
+            csl = (c0, Mg) if fine else None
+            i0 = jnp.int32(0)  # index dtype anchor for dynamic updates
+
+            def sl(x):
+                """Active cluster-factor rows (fine-grained consensus
+                decomposition, arXiv:1603.02526); identity for
+                whole-band rounds."""
+                if not fine:
+                    return x
+                return jax.lax.dynamic_slice_in_dim(x, c0, Mg, axis=0)
+
             d_g = jax.tree_util.tree_map(
                 lambda x: jax.lax.dynamic_index_in_dim(x, g, keepdims=False),
                 data,
@@ -332,19 +524,117 @@ def make_admm_mesh_fn(
             p_g = p[g]
             Y_g = Y[g]
             rho_g = rho[g]
-            BZ_g = bz_of(Z, g)
-            loc = _fit(d_g, c_g, p_g, Y_g, BZ_g, rho_g, max_emiter)
+            if use_staleness:
+                ages = consensus.slot_staleness_ages(g, G)
+                w = consensus.staleness_weights(
+                    ages, ccfg.staleness, ccfg.staleness_discount,
+                    dtype=p0.dtype,
+                )
+            else:
+                w = None
+            if zmode == "grouped":
+                Z = Zc
+                BZ_g = bz_of(Z, g)
+            elif zmode == "reduced_gather":
+                Z, Zsh, num_shard = Zc
+                BZ_g = bz_of(Z, g)
+            else:
+                Zsh, num_shard = Zc
+                BZ_g = a2a_bz(Zsh, slot_row, group_row, g)  # active rows
+                if fine:
+                    pad = jnp.zeros((M,) + BZ_g.shape[1:], BZ_g.dtype)
+                    BZ_g = jax.lax.dynamic_update_slice(
+                        pad, BZ_g, (c0, i0, i0)
+                    )
+            loc = _fit(d_g, c_g, p_g, Y_g, BZ_g, rho_g, max_emiter,
+                       cluster_slice=csl)
             p1_g = loc.p
-            Yhat_g = Y_g + rho_g[:, None, None] * p1_g
+            if fine:
+                Yhat_act = sl(Y_g) + sl(rho_g)[:, None, None] * sl(p1_g)
+                Yhat_all1 = jax.lax.dynamic_update_slice(
+                    Yhat_all, Yhat_act[None], (g, c0, i0, i0)
+                )
+            else:
+                Yhat_act = Y_g + rho_g[:, None, None] * p1_g
+                Yhat_all1 = Yhat_all.at[g].set(Yhat_act)
             p1 = p.at[g].set(p1_g)
-            Yhat_all1 = Yhat_all.at[g].set(Yhat_g)
             if use_spatial:
                 Zbar_flat, Xsp = spstate[0], spstate[1]
                 z_extra = alpha_sp[:, None, None] * Zbar_flat - Xsp
-                Z1 = _zstep_grouped(
-                    _flat(Yhat_all1), rho, B_g, axis_name,
-                    federated_alpha=alpha_sp, z_extra=z_extra,
-                )
+            if zmode == "grouped":
+                if use_spatial:
+                    Z1 = _zstep_grouped(
+                        _flat(Yhat_all1), rho, B_g, axis_name,
+                        federated_alpha=alpha_sp, z_extra=z_extra,
+                        weights=w,
+                    )
+                else:
+                    Z1 = _zstep_grouped(_flat(Yhat_all1), rho, B_g,
+                                        axis_name, weights=w)
+                Zc1 = Z1
+                BZ1_g = bz_of(Z1, g)
+                BZ1_act = sl(BZ1_g)
+                dres = consensus.admm_dual_residual(Z1, Z)
+            else:
+                if use_staleness:
+                    num_shard1 = _num_scatter(_flat(Yhat_all1), weights=w)
+                else:
+                    # incremental transpose reduction: only the active
+                    # (slot, group) factor's Yhat moved this round, so
+                    # only its basis-outer-product delta crosses the
+                    # mesh (the group schedule is device-uniform, so
+                    # the Mg delta rows align across devices)
+                    old_act = (
+                        jax.lax.dynamic_slice(
+                            Yhat_all, (g, c0, i0, i0),
+                            (1, Mg, nchunk_max, n8),
+                        )[0]
+                        if fine else Yhat_all[g]
+                    )
+                    delta = consensus.accumulate_z_term(
+                        B_g[g], _flat(Yhat_act - old_act)
+                    )
+                    dsh = jax.lax.psum_scatter(
+                        delta, axis_name, scatter_dimension=2, tiled=True
+                    )
+                    if fine:
+                        cur = jax.lax.dynamic_slice(
+                            num_shard, (c0, i0, i0), (Mg, Npoly, Ks)
+                        )
+                        num_shard1 = jax.lax.dynamic_update_slice(
+                            num_shard, cur + dsh, (c0, i0, i0)
+                        )
+                    else:
+                        num_shard1 = num_shard + dsh
+                if den_static:
+                    Bii = Bii0
+                else:
+                    Bii = _den_inv(
+                        rho, weights=w,
+                        federated_alpha=alpha_sp if use_spatial else None,
+                    )
+                num_solve = num_shard1
+                if use_spatial:
+                    did_z = jax.lax.axis_index(axis_name)
+                    num_solve = num_solve + jax.lax.dynamic_slice_in_dim(
+                        z_extra, did_z * Ks, Ks, axis=2
+                    )
+                Zsh1 = consensus.update_global_z(num_solve, Bii)
+                if zmode == "reduced_gather":
+                    Z1 = jax.lax.all_gather(Zsh1, axis_name, axis=2,
+                                            tiled=True)
+                    BZ1_g = bz_of(Z1, g)
+                    BZ1_act = sl(BZ1_g)
+                    dres = consensus.admm_dual_residual(Z1, Z)
+                    Zc1 = (Z1, Zsh1, num_shard1)
+                else:
+                    BZ1_act = a2a_bz(Zsh1, slot_row, group_row, g)
+                    dd = (Zsh1 - Zsh).ravel()
+                    dres = jnp.sqrt(
+                        jax.lax.psum(jnp.sum(dd * dd), axis_name)
+                    ) / jnp.sqrt(jnp.asarray(M * Npoly * K, dd.dtype))
+                    Zc1 = (Zsh1, num_shard1)
+            if use_spatial:
                 # cadenced spatial re-fit (sagecal_master.cpp:887-930)
                 do_sp = (it % spatial.cadence) == 0
                 spstate1 = jax.lax.cond(
@@ -356,37 +646,62 @@ def make_admm_mesh_fn(
                     (Z1, spstate),
                 )
             else:
-                Z1 = _zstep_grouped(_flat(Yhat_all1), rho, B_g, axis_name)
                 spstate1 = spstate
-            BZ1_g = bz_of(Z1, g)
-            Y1 = Y.at[g].set(Yhat_g - rho_g[:, None, None] * BZ1_g)
-            dres = consensus.admm_dual_residual(Z1, Z)
-            pr = _flat(p1_g - BZ1_g)
+            if fine:
+                Ynew_act = Yhat_act - sl(rho_g)[:, None, None] * BZ1_act
+                Y1 = jax.lax.dynamic_update_slice(
+                    Y, Ynew_act[None], (g, c0, i0, i0)
+                )
+            else:
+                Y1 = Y.at[g].set(Yhat_act - rho_g[:, None, None] * BZ1_act)
+            pr = _flat((sl(p1_g) if fine else p1_g) - BZ1_act)
             pres = jax.lax.pmean(
                 jnp.linalg.norm(pr.ravel()) / jnp.sqrt(pr.size), axis_name
             )
             if bb_rho:
-                dY = _flat(Yhat_g) - _flat(Yhat_prev[g])
-                dJ = _flat(p1_g) - _flat(p_prev[g])
-                rho_new_g = consensus.update_rho_bb(
-                    rho_g, jnp.full_like(rho_g, rho_upper), dY, dJ
-                )
-                # BB cadence: update every other visit to this slot
-                # (sagecal_slave.cpp:899)
-                visit = (it - 1) // G
-                rho1 = rho.at[g].set(
-                    jnp.where(visit % 2 == 1, rho_new_g, rho_g)
-                )
+                if fine:
+                    dY = _flat(Yhat_act) - _flat(sl(Yhat_prev[g]))
+                    dJ = _flat(sl(p1_g)) - _flat(sl(p_prev[g]))
+                    rho_new_act = consensus.update_rho_bb(
+                        sl(rho_g),
+                        jnp.full((Mg,), rho_upper, rho_g.dtype), dY, dJ,
+                    )
+                    visit = (it - 1) // (G * cg)
+                    upd = jnp.where(visit % 2 == 1, rho_new_act,
+                                    sl(rho_g))
+                    rho1 = jax.lax.dynamic_update_slice(
+                        rho, upd[None], (g, c0)
+                    )
+                else:
+                    dY = _flat(Yhat_act) - _flat(Yhat_prev[g])
+                    dJ = _flat(p1_g) - _flat(p_prev[g])
+                    rho_new_g = consensus.update_rho_bb(
+                        rho_g, jnp.full_like(rho_g, rho_upper), dY, dJ
+                    )
+                    # BB cadence: update every other visit to this slot
+                    # (sagecal_slave.cpp:899)
+                    visit = (it - 1) // G
+                    rho1 = rho.at[g].set(
+                        jnp.where(visit % 2 == 1, rho_new_g, rho_g)
+                    )
             else:
                 rho1 = rho
-            Yhat_prev1 = Yhat_prev.at[g].set(Yhat_g)
-            p_prev1 = p_prev.at[g].set(p1_g)
+            if fine:
+                Yhat_prev1 = jax.lax.dynamic_update_slice(
+                    Yhat_prev, Yhat_act[None], (g, c0, i0, i0)
+                )
+                p_prev1 = jax.lax.dynamic_update_slice(
+                    p_prev, sl(p1_g)[None], (g, c0, i0, i0)
+                )
+            else:
+                Yhat_prev1 = Yhat_prev.at[g].set(Yhat_act)
+                p_prev1 = p_prev.at[g].set(p1_g)
             sres_out = spstate1[3] if use_spatial else jnp.zeros((), p0.dtype)
             ys = (dres, pres, sres_out)
             if collect_trace:
                 prn, ddn = band_residuals(p1, Z1, Z, rho1)
                 ys = ys + (prn, ddn, rho1)
-            return (p1, Y1, Z1, rho1, Yhat_all1, Yhat_prev1, p_prev1,
+            return (p1, Y1, Zc1, rho1, Yhat_all1, Yhat_prev1, p_prev1,
                     spstate1), ys
 
         spstate0 = (
@@ -396,14 +711,27 @@ def make_admm_mesh_fn(
             if use_spatial
             else jnp.zeros((), p0.dtype)
         )
-        init = (p, Y, Z, rho, Yhat, Yhat, p, spstate0)
+        if zmode == "grouped":
+            Zc0 = Z
+        elif zmode == "reduced_gather":
+            Zc0 = (Z, Zsh, num_shard)
+        else:
+            Zc0 = (Zsh, num_shard)
+        init = (p, Y, Zc0, rho, Yhat, Yhat, p, spstate0)
         if collect_trace:
             # iteration-0 rows: residuals of the plain solve vs the first
             # consensus (dual term is 0 by construction, dZ = 0)
             prn0, _ = band_residuals(p, Z, Z, rho)
             rho0 = rho
         carry, ys = jax.lax.scan(one_iter, init, jnp.arange(1, nadmm))
-        (p, Y, Z, rho, _, _, _, spstate) = carry
+        (p, Y, Zc, rho, _, _, _, spstate) = carry
+        if zmode == "grouped":
+            Z = Zc
+        elif zmode == "reduced_gather":
+            Z = Zc[0]
+        else:
+            # one-time reassembly of the replicated consensus result
+            Z = jax.lax.all_gather(Zc[0], axis_name, axis=2, tiled=True)
         (dres, pres, sres) = ys[:3]
         dres = jnp.concatenate([jnp.zeros((1,), dres.dtype), dres])
         pres = jnp.concatenate([jnp.zeros((1,), pres.dtype), pres])
@@ -430,8 +758,6 @@ def make_admm_mesh_fn(
         # band-axis telemetry shards on axis 1 (axis 0 is the iteration)
         bspec = P(None, axis_name)
         out_specs = out_specs + (bspec, bspec, bspec)
-
-    ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
 
     @instrumented_jit(name="mesh.admm")
     def fn(data_stack, cdata_stack, p0, rho, B):
@@ -475,6 +801,10 @@ def make_admm_mesh_fn(
                      async_dispatch=True):
             return fn(data_stack, cdata_stack, p0, rho, B)
 
+    # AOT hook for the comms bench / regression gate: .lower(*args)
+    # .compile() on this handle feeds obs.perf.collective_cost_analysis
+    # without executing the program
+    traced_fn.inner_jit = fn
     return traced_fn
 
 

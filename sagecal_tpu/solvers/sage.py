@@ -415,7 +415,8 @@ def predict_full_model(p_all, cdata: ClusterData, data: VisData):
     return jnp.stack([v00, v01, v10, v11], axis=-2)
 
 
-def em_residual_scan(data: VisData, cdata: ClusterData, p_all, extras, solve_one):
+def em_residual_scan(data: VisData, cdata: ClusterData, p_all, extras, solve_one,
+                     cluster_slice=None):
     """One SAGE expectation pass: scan clusters with the residual as carry
     (the add-back / solve / subtract structure of lmfit.c:876-986).
 
@@ -423,6 +424,14 @@ def em_residual_scan(data: VisData, cdata: ClusterData, p_all, extras, solve_one
     runs the per-cluster maximization against ``xeff`` = residual with
     this cluster's current model restored.  ``extras``: pytree of arrays
     with leading cluster axis (or None).  Returns (p_new (M,...), aux).
+
+    ``cluster_slice``: optional ``(start, count)`` — solve only the
+    ``count`` clusters beginning at (dynamic) index ``start``, holding
+    the rest fixed.  The initial residual still subtracts the FULL model
+    (fixed clusters stay subtracted throughout, exactly as if their
+    scan steps ran with a no-op solver), so a sliced pass is the
+    fine-grained consensus factor-node update of parallel/mesh.py:
+    per-round work scales with ``count`` while the physics stays whole.
     """
 
     def cluster_step(xres, inp):
@@ -434,9 +443,18 @@ def em_residual_scan(data: VisData, cdata: ClusterData, p_all, extras, solve_one
         return xeff - model_new, (p_new, aux)
 
     xres0 = data.vis - predict_full_model(p_all, cdata, data)
-    _, (p_new, aux) = jax.lax.scan(
-        cluster_step, xres0, (cdata.coh, cdata.chunk_map, p_all, extras)
-    )
+    xs = (cdata.coh, cdata.chunk_map, p_all, extras)
+    if cluster_slice is not None:
+        start, count = cluster_slice
+        xs = jax.tree_util.tree_map(
+            lambda x: jax.lax.dynamic_slice_in_dim(x, start, count, axis=0),
+            xs,
+        )
+    _, (p_new, aux) = jax.lax.scan(cluster_step, xres0, xs)
+    if cluster_slice is not None:
+        p_new = jax.lax.dynamic_update_slice_in_dim(
+            p_all, p_new, cluster_slice[0], axis=0
+        )
     return p_new, aux
 
 

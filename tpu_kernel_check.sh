@@ -168,6 +168,39 @@ v = validate_solutions('$ELDIR/sol_fused.txt')
 assert v['n_intervals'] == 4 and v['torn_rows'] == 0, v
 print('fused elastic smoke ok:', v)" \
   || { echo "fused elastic smoke validate FAILED"; exit 1; }
+echo "=== async-consensus smoke (CPU, bounded staleness K=1)"
+# bounded-staleness consensus end to end through the CLI (-w 2 bands,
+# --consensus-staleness 1): the run must complete with an untorn
+# solution file, and the staleness schedule must provably cut the
+# attributed straggler ratio on a flag-skewed band layout
+ASYNCCAL=(python -m sagecal_tpu.apps.cli -d "$ELDIR/d.h5" -s "$ELDIR/sky.txt"
+       -p "$ELDIR/sol_async.txt" -t 2 -N 1 -M 1 -w 2 -A 3 -P 2 -Q 0
+       -r 2.0 -l 6 -j 1 --consensus-staleness 1
+       --consensus-staleness-discount 0.9)
+JAX_PLATFORMS=cpu timeout 420 "${ASYNCCAL[@]}" \
+  || { echo "async-consensus run FAILED rc=$?"; exit 1; }
+JAX_PLATFORMS=cpu timeout 60 python -c "
+import numpy as np
+from sagecal_tpu.io.solutions import validate_solutions
+from sagecal_tpu.obs.trace import straggler_stats
+from sagecal_tpu.parallel.async_consensus import band_active, refresh_periods
+v = validate_solutions('$ELDIR/sol_async.txt')
+assert v['torn_rows'] == 0, v
+# schedule math, attributed billing: a 4x-heavy band under K=1 bills
+# half the rounds, so slowest/median must drop vs the sync schedule
+rows = [400.0, 100.0, 100.0, 100.0]
+per = refresh_periods(rows, 1)
+sync = [r * 8 for r in rows]
+asy = [0.0] * 4
+for rnd in range(8):
+    act = band_active(rnd, per)
+    for b in range(4):
+        if act[b]:
+            asy[b] += rows[b]
+rs, ra = straggler_stats(sync)['ratio'], straggler_stats(asy)['ratio']
+assert ra < rs, (rs, ra)
+print('async smoke ok:', v, 'straggler ratio %.2f -> %.2f' % (rs, ra))" \
+  || { echo "async-consensus smoke validate FAILED"; exit 1; }
 echo "=== multi-tenant serve smoke (CPU, synthetic mixed shapes)"
 SRVDIR=$(mktemp -d)
 JAX_PLATFORMS=cpu timeout 420 python -m sagecal_tpu.apps.cli serve \
