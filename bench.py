@@ -704,6 +704,62 @@ def run_serve_bench(batch=8, repeats=5, device=None,
     }
 
 
+def run_refine_bench(outer_iters=3, nstations=5, tilesz=2):
+    """Sky-model refinement row: the bilevel outer loop (implicit
+    IFT-adjoint route) recovering a 15%-perturbed source flux through
+    the inner gain solve, on the shared simulated-sky fixture.
+
+    Two gate-able numbers (obs/perf.py knows the directions):
+    ``refine_flux_err`` — recovered relative flux error after
+    ``outer_iters`` outer steps (lower-better; the <1% acceptance bar
+    from the refine smoke) — and ``refine_outer_iters_per_sec``
+    (higher-better).  Timing includes the compiles: a refine run pays
+    them once up front, and three outer steps is exactly the cold-run
+    shape the smoke test uses, so the pinned number is an end-to-end
+    figure, not a warm-kernel one.  Runs f64 on the CPU backend — the
+    gradient acceptance criteria are defined there (implicit-vs-FD at
+    <=1e-3 rel needs f64; see USER_MANUAL).
+    """
+    import time as _time
+
+    import jax
+
+    from sagecal_tpu.data import make_sky, perturb_flux
+    from sagecal_tpu.refine import RefineProblem, SkySpec, run_refine
+
+    old_x64 = bool(jax.config.jax_enable_x64)
+    jax.config.update("jax_enable_x64", True)
+    try:
+        with jax.default_device(_cpu_device()):
+            sky = make_sky(nstations=nstations, tilesz=tilesz, nchan=1,
+                           nclusters=2, sources_per_cluster=2,
+                           gain_amp=0.08, noise_sigma=0.0, seed=3,
+                           dtype=np.float64)
+            clusters = perturb_flux(sky, factor=1.15, cluster=0, source=0)
+            problem = RefineProblem(data=sky.data, clusters=clusters,
+                                    tables=sky.shapelet_tables,
+                                    spec=SkySpec(flux=[(0, 0)]),
+                                    ridge=1e-2)
+            t0 = _time.perf_counter()
+            res = run_refine(problem, outer_iters=outer_iters,
+                             gradient="implicit", inner_iters=8,
+                             cg_iters=30, damping=1e-6,
+                             adjoint_cg_iters=60)
+            dt = _time.perf_counter() - t0
+        true_flux = float(sky.true_flux[0][0])
+        err = abs(float(res.theta[0]) - true_flux) / true_flux
+    finally:
+        jax.config.update("jax_enable_x64", old_x64)
+    return {
+        "outer_iters": outer_iters,
+        "nstations": nstations,
+        "gradient": "implicit",
+        "refine_flux_err": float(err),
+        "refine_outer_iters_per_sec": round(outer_iters / dt, 4),
+        "refine_wall_s": round(dt, 3),
+    }
+
+
 def _latest_flight_dump():
     """Newest flight-recorder dump matching the configured dump path, so
     the recovery event links straight to the forensics artifact."""
@@ -856,6 +912,18 @@ def main():
         with tracer.span("bench", kind="run", variant="admm_comms"):
             comms_rec = run_admm_comms_bench()
 
+    # sky-model refinement row: bilevel flux recovery + outer-loop
+    # throughput on the simulated-sky fixture (f64 CPU — the regime the
+    # gradient acceptance bounds are defined in).
+    # SAGECAL_BENCH_NO_REFINE=1 skips it.
+    refine_rec = None
+    if not os.environ.get("SAGECAL_BENCH_NO_REFINE"):
+        with tracer.span("bench", kind="run", variant="refine"):
+            try:
+                refine_rec = run_refine_bench()
+            except Exception as exc:  # never sink the headline bench
+                sys.stderr.write(f"bench: refine bench failed: {exc}\n")
+
     cpu_measured = None
     if os.environ.get("SAGECAL_BENCH_MEASURE_CPU"):
         cpu_measured = _measure_cpu_subprocess(tilesz)
@@ -952,6 +1020,13 @@ def main():
         rec["serve_batch_speedup"] = serve_rec["serve_batch_speedup"]
         rec["serve_p50_latency_s"] = serve_rec["serve_p50_latency_s"]
         rec["serve_bench"] = serve_rec
+    if refine_rec is not None:
+        # gate-able refine rows (obs/perf.py knows the directions):
+        # flux error lower-better, outer throughput higher-better
+        rec["refine_flux_err"] = refine_rec["refine_flux_err"]
+        rec["refine_outer_iters_per_sec"] = (
+            refine_rec["refine_outer_iters_per_sec"])
+        rec["refine_bench"] = refine_rec
     if bf16_variant is not None:
         # gate-able bf16-coherency row (obs/perf.py knows directions):
         # throughput higher-better, compiled bytes accessed lower-better

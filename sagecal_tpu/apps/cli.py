@@ -290,6 +290,20 @@ def main(argv=None):
         from sagecal_tpu.apps.serve import main as serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "refine":
+        # differentiable sky-model refinement (sagecal_tpu/refine/):
+        # outer LBFGS over sky parameters around the inner gain solve;
+        # owns its own flag surface and exit codes (apps/refine.py)
+        from sagecal_tpu.apps.refine import main as refine_main
+
+        return refine_main(argv[1:])
+    if argv and argv[0] == "spatial":
+        # spatial regularization as a standalone workload: per-band
+        # solves -> consensus polynomial + AIC/MDL -> FISTA fit
+        # (apps/spatial.py)
+        from sagecal_tpu.apps.spatial import main as spatial_main
+
+        return spatial_main(argv[1:])
     if argv and argv[0] == "convert":
         # convert <ms> <h5> [spw] — multi-SPW MSs convert one window
         # per .h5 band file (the reference expects pre-split MSs)

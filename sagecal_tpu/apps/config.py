@@ -108,6 +108,93 @@ class RunConfig:
 
 
 @dataclasses.dataclass
+class RefineConfig:
+    """``sagecal-tpu refine``: differentiable sky-model refinement
+    (sagecal_tpu/refine/).  An outer LBFGS over the free sky parameters
+    wraps the inner gain solve; gradients flow through the inner fixed
+    point (implicit function theorem by default, truncated unrolling as
+    the fallback).  XLA predict path only — the fused kernel has no
+    coherency cotangent (see refine.objective.require_xla_predict)."""
+
+    dataset: str = ""  # vis.h5 (one tile); empty with synthetic>0
+    sky_model: str = ""
+    cluster_file: str = ""
+    out_prefix: str = "refine-out"  # <prefix>.json / .npz / .trace.jsonl
+    tilesz: int = 2
+    # which parameters are free: "c:s" entries (cluster:source index),
+    # comma-separated; modes entries are "c:m" (cluster:flat mode idx)
+    free_flux: str = "0:0"
+    free_spec: str = ""
+    free_pos: str = ""
+    free_modes: str = ""
+    # outer loop
+    outer_iters: int = 10
+    lbfgs_m: int = 7
+    gradient: str = "implicit"  # or "unrolled"
+    tol: float = 0.0
+    # inner solve / adjoint
+    inner_iters: int = 12
+    cg_iters: int = 32
+    damping: float = 1e-6
+    adjoint_cg_iters: int = 64
+    adjoint_matvec: str = "hvp"  # or "jtj" (Gauss-Newton)
+    ridge: float = 1e-2  # inner gain prior (degeneracy breaker)
+    # synthetic mode (smoke/bench/tests): simulate a make_sky fixture,
+    # perturb one flux by this factor, refine it back
+    synthetic: int = 0  # >0: nstations of the synthetic sky
+    perturb: float = 1.15
+    noise_sigma: float = 0.0
+    seed: int = 3
+    # elastic (outer-state checkpoints at outer-iteration boundaries)
+    resume: bool = False
+    checkpoint_every: int = 0
+    checkpoint_dir: Optional[str] = None
+    use_f64: bool = True
+    verbose: bool = False
+
+
+@dataclasses.dataclass
+class SpatialConfig:
+    """``sagecal-tpu spatial``: spatial regularization as a first-class
+    workload — per-band calibration solves -> consensus polynomial ->
+    FISTA elastic-net fit of Z onto the spatial basis
+    (parallel/spatial.py) + AIC/MDL consensus-order scan."""
+
+    band_pattern: str = ""  # glob of per-band vis.h5; empty = synthetic
+    sky_model: str = ""
+    cluster_file: str = ""
+    out_prefix: str = "spatial-out"  # <prefix>.json / .npz
+    tilesz: int = 2
+    # per-band solver (RunConfig semantics)
+    max_emiter: int = 3
+    max_iter: int = 2
+    max_lbfgs: int = 10
+    lbfgs_m: int = 7
+    solver_mode: int = SM_OSLM_OSRLM_RLBFGS
+    # consensus + spatial
+    admm_rho: float = 5.0
+    npoly: int = 2
+    poly_type: int = 2
+    spatial_n0: int = 2
+    spatial_beta: float = 0.0  # <=0: master's auto scale
+    spatial_basis: str = "shapelet"
+    spatial_mu: float = 1e-3
+    fista_maxiter: int = 60
+    mdl_kmax: int = 0  # 0: max(npoly, 2)
+    # synthetic mode: make_multiband_skies bands
+    synthetic: int = 0  # >0: number of synthetic bands
+    nstations: int = 7
+    noise_sigma: float = 0.0
+    seed: int = 5
+    # elastic (checkpoint after each solved band)
+    resume: bool = False
+    checkpoint_every: int = 0
+    checkpoint_dir: Optional[str] = None
+    use_f64: bool = True
+    verbose: bool = False
+
+
+@dataclasses.dataclass
 class ServeConfig:
     """``sagecal-tpu serve``: the multi-tenant calibration service
     (sagecal_tpu/serve/).  Solver fields are SERVICE-WIDE defaults; a

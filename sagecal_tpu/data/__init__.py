@@ -1,0 +1,19 @@
+"""Simulated-sky fixtures with known ground truth (refine/spatial/
+quality test surfaces and the synthetic modes of the refine/spatial
+apps)."""
+
+from sagecal_tpu.data.simsky import (
+    SimulatedSky,
+    make_multiband_skies,
+    make_sky,
+    perturb_flux,
+    shapelet_source_batch,
+)
+
+__all__ = [
+    "SimulatedSky",
+    "make_multiband_skies",
+    "make_sky",
+    "perturb_flux",
+    "shapelet_source_batch",
+]

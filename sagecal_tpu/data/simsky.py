@@ -1,0 +1,205 @@
+"""Shared simulated-sky fixtures with known ground truth.
+
+The refine, spatial, and quality test surfaces all need the same thing:
+a physically consistent synthetic observation whose *generating*
+parameters — per-source fluxes, spectral indices, shapelet mode
+coefficients, true Jones gains — are known exactly, so recovery can be
+asserted against ground truth instead of against another code path.
+This module builds those skies on top of :mod:`sagecal_tpu.io.simulate`
+(uvw tracks, gain corruption, noise) and returns everything a test or
+app needs in one record.
+
+Design notes for the refinement acceptance tests:
+
+- Cluster 0 always holds MULTIPLE point sources.  A per-cluster flux
+  scale ``s`` applied to a single-source cluster is exactly absorbed by
+  gains scaled ``1/sqrt(s)`` (the flux/gain degeneracy); with several
+  sources sharing one gain solution the individual fluxes are
+  identifiable again, which is what lets ``refine`` recover a perturbed
+  flux *through* the calibration solve.
+- ``perturb_flux`` returns a cluster list with one source's flux scaled
+  by a known factor — the refinement start point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from sagecal_tpu.core.types import VisData
+from sagecal_tpu.io.simulate import (
+    corrupt_and_observe,
+    make_visdata,
+    random_jones,
+)
+from sagecal_tpu.ops.rime import (
+    ST_SHAPELET,
+    ShapeletTable,
+    SourceBatch,
+    point_source_batch,
+)
+
+
+def shapelet_source_batch(
+    ll, mm, flux, modes, beta: float = 0.01, f0: float = 150e6,
+    dtype=jnp.float32,
+) -> tuple[SourceBatch, ShapeletTable]:
+    """One ST_SHAPELET source at (ll, mm) with the given mode
+    coefficients: ``modes`` is (n0, n0) (or flat n0*n0) — the ground
+    truth the spatial/refine tests recover.  Returns (batch, table)."""
+    modes = np.asarray(modes, dtype=np.float64)
+    n0 = int(round(np.sqrt(modes.size)))
+    if n0 * n0 != modes.size:
+        raise ValueError(f"modes must be square, got {modes.size} coeffs")
+    src = point_source_batch([ll], [mm], [flux], f0=f0, dtype=dtype)
+    src = src.replace(
+        stype=jnp.full((1,), ST_SHAPELET, jnp.int32),
+        shapelet_idx=jnp.zeros((1,), jnp.int32),
+    )
+    tab = ShapeletTable(
+        modes=jnp.asarray(modes.reshape(1, n0 * n0), dtype),
+        beta=jnp.full((1,), beta, dtype),
+        eX=jnp.ones((1,), dtype),
+        eY=jnp.ones((1,), dtype),
+        eP=jnp.zeros((1,), dtype),
+        n0max=n0,
+    )
+    return src, tab
+
+
+@dataclasses.dataclass
+class SimulatedSky:
+    """A synthetic observation plus the exact parameters that made it."""
+
+    data: VisData
+    clusters: List[SourceBatch]
+    shapelet_tables: List[Optional[ShapeletTable]]
+    jones: jnp.ndarray  # true gains (M, N, 2, 2); None-corruption = identity
+    true_flux: List[np.ndarray]  # per-cluster ground-truth sI0
+    true_spec_idx: List[np.ndarray]
+    true_modes: Optional[np.ndarray]  # (n0, n0) shapelet truth, or None
+    freq0: float
+    dec0: float
+    noise_sigma: float
+
+    @property
+    def nclusters(self) -> int:
+        return len(self.clusters)
+
+
+def make_sky(
+    nstations: int = 8,
+    tilesz: int = 2,
+    nchan: int = 2,
+    nclusters: int = 2,
+    sources_per_cluster: int = 3,
+    freq0: float = 150e6,
+    chan_bw: float = 180e3,
+    dec0: float = 0.9,
+    gain_amp: float = 0.1,
+    noise_sigma: float = 0.0,
+    spectral: bool = False,
+    shapelet_n0: int = 0,
+    seed: int = 7,
+    dtype=np.float64,
+) -> SimulatedSky:
+    """Build a point(+shapelet) sky with known ground truth and observe
+    it through random Jones gains.
+
+    - cluster 0: ``sources_per_cluster`` point sources (multi-source by
+      construction — see module docstring on the flux/gain degeneracy);
+    - clusters 1..: single point sources at distinct directions;
+    - ``shapelet_n0 > 0`` appends one all-shapelet cluster with an
+      ``n0 x n0`` mode table drawn from a fixed RNG (ground truth in
+      ``true_modes``);
+    - ``spectral=True`` gives every source a known nonzero spectral
+      index (exercises the spec_idx != 0 gate in ``_spectral_flux``);
+    - ``gain_amp=0`` observes through identity gains (the refinement
+      acceptance setting: at the true sky + identity anchor the outer
+      misfit is exactly the noise floor).
+    """
+    rng = np.random.default_rng(seed)
+    data = make_visdata(
+        nstations=nstations, tilesz=tilesz, nchan=nchan, freq0=freq0,
+        chan_bw=chan_bw, dec0=dec0, seed=seed, dtype=dtype,
+    )
+    jdtype = jnp.complex64 if dtype == np.float32 else jnp.complex128
+
+    clusters: List[SourceBatch] = []
+    tables: List[Optional[ShapeletTable]] = []
+    true_flux: List[np.ndarray] = []
+    true_si: List[np.ndarray] = []
+    for k in range(nclusters):
+        ns = sources_per_cluster if k == 0 else 1
+        ll = rng.uniform(-0.04, 0.04, ns)
+        mm = rng.uniform(-0.04, 0.04, ns)
+        flux = rng.uniform(1.0, 4.0, ns)
+        src = point_source_batch(ll, mm, flux, f0=freq0, dtype=data.u.dtype)
+        si = np.zeros(ns)
+        if spectral:
+            si = rng.uniform(-0.9, -0.3, ns)
+            src = src.replace(spec_idx=jnp.asarray(si, data.u.dtype))
+        clusters.append(src)
+        tables.append(None)
+        true_flux.append(flux)
+        true_si.append(si)
+
+    true_modes = None
+    if shapelet_n0 > 0:
+        modes = rng.normal(0.0, 1.0, (shapelet_n0, shapelet_n0))
+        modes[0, 0] = 3.0  # dominant zeroth mode keeps the source bright
+        src, tab = shapelet_source_batch(
+            rng.uniform(-0.02, 0.02), rng.uniform(-0.02, 0.02), 1.0,
+            modes, beta=0.01, f0=freq0, dtype=data.u.dtype,
+        )
+        clusters.append(src)
+        tables.append(tab)
+        true_flux.append(np.array([1.0]))
+        true_si.append(np.zeros(1))
+        true_modes = modes
+
+    M = len(clusters)
+    jones = random_jones(M, nstations, seed=seed + 1, amp=gain_amp,
+                         dtype=jdtype)
+    data = corrupt_and_observe(
+        data, clusters, jones=jones, noise_sigma=noise_sigma,
+        seed=seed + 2, shapelet_tables=tables if shapelet_n0 > 0 else None,
+    )
+    return SimulatedSky(
+        data=data, clusters=clusters, shapelet_tables=tables, jones=jones,
+        true_flux=true_flux, true_spec_idx=true_si, true_modes=true_modes,
+        freq0=freq0, dec0=dec0, noise_sigma=noise_sigma,
+    )
+
+
+def make_multiband_skies(
+    nbands: int = 4,
+    freq0: float = 130e6,
+    band_bw: float = 10e6,
+    **kwargs,
+) -> List[SimulatedSky]:
+    """The distributed/spatial fixture: the SAME sky (same seed, same
+    source parameters, same gains) observed in ``nbands`` frequency
+    bands — what the consensus and spatial-regularization paths consume.
+    Band b is centred at ``freq0 + b * band_bw``."""
+    out = []
+    for b in range(nbands):
+        out.append(make_sky(freq0=freq0 + b * band_bw, **kwargs))
+    return out
+
+
+def perturb_flux(
+    sky: SimulatedSky, factor: float = 1.15, cluster: int = 0,
+    source: int = 0,
+) -> List[SourceBatch]:
+    """Cluster list with one source's flux scaled by ``factor`` — the
+    known-wrong sky model that ``refine`` must pull back to truth."""
+    out = list(sky.clusters)
+    src = out[cluster]
+    sI0 = np.asarray(src.sI0).copy()
+    sI0[source] *= factor
+    out[cluster] = src.replace(sI0=jnp.asarray(sI0, src.sI0.dtype))
+    return out

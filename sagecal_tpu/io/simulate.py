@@ -110,12 +110,18 @@ def corrupt_and_observe(
     noise_sigma: float = 0.0,
     seed: int = 1,
     fdelta: float = 0.0,
+    shapelet_tables=None,
 ) -> VisData:
-    """Fill ``data.vis`` with sum_k J_p^k C_pq^k J_q^kH + noise."""
+    """Fill ``data.vis`` with sum_k J_p^k C_pq^k J_q^kH + noise.
+
+    ``shapelet_tables``: optional per-cluster ShapeletTable list for
+    clusters carrying ST_SHAPELET members (simulated diffuse skies,
+    sagecal_tpu/data)."""
     rng = np.random.default_rng(seed)
     total = predict_model(
         data.u, data.v, data.w, data.freqs, clusters, fdelta,
         jones=jones, ant_p=data.ant_p, ant_q=data.ant_q,
+        shapelet_tables=shapelet_tables,
     )
     if noise_sigma > 0.0:
         nre = rng.standard_normal(total.shape)

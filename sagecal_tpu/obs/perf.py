@@ -891,13 +891,13 @@ GATE_HIGHER_BETTER = (
     "mfu_vs_v5e_bf16_peak", "bw_util_vs_v5e_819gbps",
     "warm_start_speedup", "coh_bf16_iters_per_sec",
     "solves_per_sec_per_chip", "serve_batch_speedup",
-    "admm_collective_bytes_reduction",
+    "admm_collective_bytes_reduction", "refine_outer_iters_per_sec",
 )
 GATE_LOWER_BETTER = (
     "xla_cost_analysis_bytes_accessed", "peak_device_memory_bytes",
     "compile_seconds_total", "coh_bf16_xla_cost_analysis_bytes_accessed",
     "serve_p50_latency_s", "admm_collective_bytes_per_round",
-    "admm_straggler_ratio",
+    "admm_straggler_ratio", "refine_flux_err",
 )
 # the metrics gated when present in BOTH records (others opt in via
 # --metric name=tol)
@@ -907,6 +907,7 @@ GATE_DEFAULT_METRICS = (
     "coh_bf16_xla_cost_analysis_bytes_accessed",
     "solves_per_sec_per_chip", "serve_batch_speedup", "serve_p50_latency_s",
     "admm_collective_bytes_per_round", "admm_collective_bytes_reduction",
+    "refine_flux_err", "refine_outer_iters_per_sec",
 )
 GATE_DEFAULT_TOLERANCE = 0.10
 

@@ -142,7 +142,12 @@ def fused_objective(
     (Student's-t).  Production entry for eager callers (diagnostics,
     quality reports, solver harnesses): predict, residual, weighting and
     reduction happen in ONE pass over the coherency stack — the model
-    and residual never round-trip HBM.  Differentiable w.r.t. ``p``.
+    and residual never round-trip HBM.  Differentiable w.r.t. ``p``
+    ONLY: the fused kernel has no coherency cotangent, so requesting
+    gradients w.r.t. ``cdata.coh`` (sky-model refinement) raises
+    :class:`~sagecal_tpu.ops.rime_kernel.FusedSkyGradientError` rather
+    than returning silent zeros — refinement routes through the XLA
+    predict path (``sagecal_tpu.refine``).
 
     ``p``: (M, nchunk, 8N) real solver parameters.  f32 data only (the
     kernel computes in float32).

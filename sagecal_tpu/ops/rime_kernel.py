@@ -438,6 +438,52 @@ def _fused_predict_bwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, g_ri,
 
 # ------------------------------------------------------------ public API
 
+# Capability flag for the sky-model refinement path (sagecal_tpu/refine):
+# the fused kernel's backward emits gain-table cotangents ONLY — it has
+# no coherency cotangent, so sky-parameter gradients cannot flow through
+# it.  Refinement must route its predict through the XLA path
+# (solvers.sage.predict_full_model / ops.rime.predict_coherencies);
+# requesting a coherency gradient here raises FusedSkyGradientError via
+# sky_constant() instead of silently returning zeros.
+FUSED_COHERENCY_COTANGENT = False
+
+
+class FusedSkyGradientError(NotImplementedError):
+    """A caller requested coherency (sky-parameter) gradients through
+    the fused Pallas kernel, whose backward pass only produces gain
+    cotangents.  Silent-zero cotangents are never returned."""
+
+
+@jax.custom_vjp
+def sky_constant(coh_ri):
+    """Identity marking ``coh_ri`` a solver constant on the fused path.
+
+    Forward is a no-op.  Reverse-mode differentiation THROUGH this op —
+    i.e. any request for a coherency/sky cotangent from the fused
+    kernels — raises :class:`FusedSkyGradientError` at backward-trace
+    time instead of fabricating a silent zero (the hazard the refine
+    subsystem's finite-difference pins would otherwise miss).  Gain-only
+    differentiation never touches the backward rule, so every solver
+    path is unaffected."""
+    return coh_ri
+
+
+def _sky_constant_fwd(coh_ri):
+    return coh_ri, None
+
+
+def _sky_constant_bwd(_, g):
+    raise FusedSkyGradientError(
+        "gradients w.r.t. coherencies are not implemented by the fused "
+        "Pallas kernel (its backward emits gain-table cotangents only); "
+        "route sky-model refinement through the XLA predict path "
+        "(refine.objective / solvers.sage.predict_full_model) instead "
+        "of the fused objective"
+    )
+
+
+sky_constant.defvjp(_sky_constant_fwd, _sky_constant_bwd)
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
 def fused_predict_packed(tab_re, tab_im, coh_ri, ant_p, ant_q,
@@ -445,8 +491,9 @@ def fused_predict_packed(tab_re, tab_im, coh_ri, ant_p, ant_q,
     """Full-model RIME predict, packed-real layout (module docstring).
 
     Differentiable w.r.t. ``tab_re``/``tab_im`` only — coherencies are
-    per-tile constants in every solver path (wrap in
-    ``jax.lax.stop_gradient`` at call sites for clarity)."""
+    per-tile constants in every solver path (the chunked wrappers guard
+    them with :func:`sky_constant`, which raises on any coherency
+    cotangent request rather than returning silent zeros)."""
     return _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
                                    tile=tile)
 
@@ -474,10 +521,10 @@ def fused_predict_packed_hybrid(tab_re, tab_im, coh_ri, ant_p, ant_q, cmap,
     """Hybrid-chunk variant (reference nchunk > 1, lmfit.c:86-87):
     ``tab_re/tab_im`` are component-major (4, Mp*nc, NPAD) with one
     row per (cluster, chunk) in each component plane, ``cmap``
-    (Mp, rowsp) int32 selects each row's chunk.  ``nc`` is static.  Differentiable w.r.t.
-    ``tab_re``/``tab_im`` ONLY — gradients w.r.t. ``coh_ri`` are
-    silently zero (wrap it in ``jax.lax.stop_gradient`` at call
-    sites)."""
+    (Mp, rowsp) int32 selects each row's chunk.  ``nc`` is static.
+    Differentiable w.r.t. ``tab_re``/``tab_im`` ONLY — a coherency
+    cotangent request raises through :func:`sky_constant` at the
+    chunked wrappers (never silent zeros)."""
     return _fused_predict_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
                                    tile=tile, nc=nc, cmap=cmap)
 
@@ -557,11 +604,12 @@ def fused_predict_packed_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
     call."""
     _, F, _, rowsp = coh_ri.shape
     plan = _chunk_plan(rowsp, tile, max_rows)
+    # coherencies are constants of the solve on BOTH branches: the same
+    # sky_constant guard (raise on coherency cotangent, not silent
+    # zeros) keeps the plan-None and chunked paths identical
+    coh_ri = sky_constant(coh_ri)
     if plan is None:
-        # coherencies are constants of the solve on the chunked path
-        # too (stop_gradient inside one()); keep both paths identical
-        return fused_predict_packed(tab_re, tab_im,
-                                    jax.lax.stop_gradient(coh_ri),
+        return fused_predict_packed(tab_re, tab_im, coh_ri,
                                     ant_p, ant_q, tile)
     n, chunk = plan
 
@@ -569,8 +617,7 @@ def fused_predict_packed_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
         c = jax.lax.dynamic_slice_in_dim(coh_ri, i * chunk, chunk, axis=3)
         p = jax.lax.dynamic_slice_in_dim(ant_p, i * chunk, chunk, axis=1)
         q = jax.lax.dynamic_slice_in_dim(ant_q, i * chunk, chunk, axis=1)
-        return fused_predict_packed(tab_re, tab_im,
-                                    jax.lax.stop_gradient(c), p, q, tile)
+        return fused_predict_packed(tab_re, tab_im, c, p, q, tile)
 
     return _map_row_chunks(one, n, chunk, F, rowsp)
 
@@ -584,10 +631,10 @@ def fused_predict_packed_hybrid_chunked(tab_re, tab_im, coh_ri, ant_p,
     per-row arrays."""
     _, F, _, rowsp = coh_ri.shape
     plan = _chunk_plan(rowsp, tile, max_rows)
+    coh_ri = sky_constant(coh_ri)
     if plan is None:
         return fused_predict_packed_hybrid(
-            tab_re, tab_im, jax.lax.stop_gradient(coh_ri), ant_p,
-            ant_q, cmap, nc, tile)
+            tab_re, tab_im, coh_ri, ant_p, ant_q, cmap, nc, tile)
     n, chunk = plan
 
     def one(i):
@@ -596,7 +643,7 @@ def fused_predict_packed_hybrid_chunked(tab_re, tab_im, coh_ri, ant_p,
         q = jax.lax.dynamic_slice_in_dim(ant_q, i * chunk, chunk, axis=1)
         cm = jax.lax.dynamic_slice_in_dim(cmap, i * chunk, chunk, axis=1)
         return fused_predict_packed_hybrid(
-            tab_re, tab_im, jax.lax.stop_gradient(c), p, q, cm, nc, tile)
+            tab_re, tab_im, c, p, q, cm, nc, tile)
 
     return _map_row_chunks(one, n, chunk, F, rowsp)
 
@@ -945,14 +992,16 @@ def fused_cost_packed_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
     """Fused objective for row counts too long for one Mosaic grid:
     per-row arrays are sliced into equal tile-aligned chunks (see
     fused_predict_packed_chunked) and the per-chunk scalar costs summed.
-    vis/mask/coh are constants of the solve (stop_gradient, matching
-    the predict wrappers)."""
+    vis/mask stay stop_gradient data constants; coherencies go through
+    the sky_constant guard (raise on a sky-gradient request, matching
+    the predict wrappers — never silent zeros)."""
     _, F, _, rowsp = coh_ri.shape
     plan = _chunk_plan(rowsp, tile, max_rows)
     nu_arr = _nu_cell(nu)
     robust = nu is not None
+    coh_ri = sky_constant(coh_ri)
     if plan is None:
-        return _fused_cost(tab_re, tab_im, jax.lax.stop_gradient(coh_ri),
+        return _fused_cost(tab_re, tab_im, coh_ri,
                            ant_p, ant_q, jax.lax.stop_gradient(vis_ri),
                            jax.lax.stop_gradient(mask_p), nu_arr, robust,
                            tile)
@@ -964,7 +1013,7 @@ def fused_cost_packed_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
         q = jax.lax.dynamic_slice_in_dim(ant_q, i * chunk, chunk, axis=1)
         v = jax.lax.dynamic_slice_in_dim(vis_ri, i * chunk, chunk, axis=2)
         m = jax.lax.dynamic_slice_in_dim(mask_p, i * chunk, chunk, axis=1)
-        return _fused_cost(tab_re, tab_im, jax.lax.stop_gradient(c), p, q,
+        return _fused_cost(tab_re, tab_im, c, p, q,
                            jax.lax.stop_gradient(v),
                            jax.lax.stop_gradient(m), nu_arr, robust, tile)
 
@@ -980,9 +1029,10 @@ def fused_cost_packed_hybrid_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
     plan = _chunk_plan(rowsp, tile, max_rows)
     nu_arr = _nu_cell(nu)
     robust = nu is not None
+    coh_ri = sky_constant(coh_ri)
     if plan is None:
         return _fused_cost_hybrid(
-            tab_re, tab_im, jax.lax.stop_gradient(coh_ri), ant_p, ant_q,
+            tab_re, tab_im, coh_ri, ant_p, ant_q,
             jax.lax.stop_gradient(vis_ri), jax.lax.stop_gradient(mask_p),
             nu_arr, cmap, nc, robust, tile)
     n, chunk = plan
@@ -995,7 +1045,7 @@ def fused_cost_packed_hybrid_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
         m = jax.lax.dynamic_slice_in_dim(mask_p, i * chunk, chunk, axis=1)
         cm = jax.lax.dynamic_slice_in_dim(cmap, i * chunk, chunk, axis=1)
         return _fused_cost_hybrid(
-            tab_re, tab_im, jax.lax.stop_gradient(c), p, q,
+            tab_re, tab_im, c, p, q,
             jax.lax.stop_gradient(v), jax.lax.stop_gradient(m), nu_arr,
             cm, nc, robust, tile)
 

@@ -1,0 +1,50 @@
+"""Differentiable sky-model refinement (ROADMAP item 5).
+
+Bilevel calibration: an outer LBFGS over sky parameters (fluxes,
+spectral indices, positions, shapelet coefficients — see
+:class:`~sagecal_tpu.refine.skyparams.SkySpec`) wrapped around the
+inner gain solve, with gradients through the inner fixed point via the
+implicit function theorem (``jax.custom_vjp`` + CG adjoint) or
+truncated unrolling.  Coherencies are recomputed from the sky inside
+the objective — the XLA predict path; the fused Pallas kernel has no
+coherency cotangent and fails loudly if asked
+(``ops.rime_kernel.FusedSkyGradientError``).
+"""
+
+from sagecal_tpu.refine.implicit import (
+    cg_solve,
+    gauss_newton_solve,
+    make_inner_solver,
+)
+from sagecal_tpu.refine.objective import (
+    RefineProblem,
+    cluster_coherencies,
+    cluster_data_from_theta,
+    inner_cost,
+    outer_cost,
+    require_xla_predict,
+    residual_vec,
+)
+from sagecal_tpu.refine.outer import (
+    RefineResult,
+    make_outer_value_and_grad,
+    run_refine,
+)
+from sagecal_tpu.refine.skyparams import SkySpec
+
+__all__ = [
+    "RefineProblem",
+    "RefineResult",
+    "SkySpec",
+    "cg_solve",
+    "cluster_coherencies",
+    "cluster_data_from_theta",
+    "gauss_newton_solve",
+    "inner_cost",
+    "make_inner_solver",
+    "make_outer_value_and_grad",
+    "outer_cost",
+    "require_xla_predict",
+    "residual_vec",
+    "run_refine",
+]

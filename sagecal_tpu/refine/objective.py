@@ -1,0 +1,162 @@
+"""Differentiable bilevel objectives for sky-model refinement.
+
+The inner (calibration) and outer (refinement) problems share one
+residual: ``r(p, theta) = mask * (vis - sum_k J_p^k C^k(theta)
+J_q^kH)`` — with the crucial difference from every solver path that the
+cluster coherencies ``C^k(theta)`` are RECOMPUTED from sky parameters
+inside the objective (``ops.rime.predict_coherencies``) instead of
+being treated as constants.  That is what lets gradients flow from
+residuals through the calibration solve into fluxes, spectral indices,
+positions and shapelet coefficients.
+
+This is the XLA predict path by construction: the fused Pallas kernel
+has no coherency cotangent (``ops.rime_kernel.FUSED_COHERENCY_COTANGENT
+is False`` — requesting one raises ``FusedSkyGradientError``), so the
+refinement subsystem checks that capability flag and never routes
+through the fused objective.
+
+Inner vs outer cost, and why they differ:
+
+- inner  ``f(p, theta) = 0.5 ||r||^2 + 0.5 ridge ||p - p_anchor||^2``
+- outer  ``h(p, theta) = 0.5 ||r||^2``
+
+The gain ridge (anchor = identity gains by default) does two jobs.  It
+breaks the flux/gain degeneracy — a per-cluster flux scale ``s`` is
+exactly absorbed by gains scaled ``1/sqrt(s)``, so without the prior
+the outer gradient w.r.t. a single-source cluster's flux would vanish
+identically.  And it makes the inner objective differ from the outer
+one, so the implicit-function-theorem adjoint term is nonzero and the
+finite-difference pins in tests/test_refine.py actually exercise it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from sagecal_tpu.core.types import VisData
+from sagecal_tpu.ops.rime import ShapeletTable, SourceBatch, predict_coherencies
+from sagecal_tpu.refine.skyparams import SkySpec
+from sagecal_tpu.solvers.sage import ClusterData, predict_full_model
+
+
+def require_xla_predict(use_fused_predict: bool) -> None:
+    """Refinement capability check: the fused kernel cannot produce the
+    coherency cotangents refinement needs — fail loudly at config time
+    rather than at backward-trace time."""
+    from sagecal_tpu.ops.rime_kernel import FUSED_COHERENCY_COTANGENT
+
+    if use_fused_predict and not FUSED_COHERENCY_COTANGENT:
+        raise ValueError(
+            "sky-model refinement requires the XLA predict path: the "
+            "fused Pallas kernel's backward emits gain cotangents only "
+            "(FUSED_COHERENCY_COTANGENT=False). Drop --fused for the "
+            "refine app."
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class RefineProblem:
+    """Everything the bilevel objectives close over (host-level arrays;
+    never traced).  ``p`` is handled FLAT — ``(M * 8N,)`` real — and
+    reshaped to the solver layout ``(M, 1, 8N)`` at the predict;
+    refinement is restricted to nchunk=1 solves."""
+
+    data: VisData
+    clusters: List[SourceBatch]
+    tables: Optional[List[Optional[ShapeletTable]]]
+    spec: SkySpec
+    fdelta: float = 0.0
+    ridge: float = 1e-2
+    p_anchor: Optional[jnp.ndarray] = None  # flat (M*8N,); None = identity
+    source_chunk: int = 32
+
+    @property
+    def nclusters(self) -> int:
+        return len(self.clusters)
+
+    @property
+    def nstations(self) -> int:
+        return self.data.nstations
+
+    @property
+    def nparams_p(self) -> int:
+        return self.nclusters * 8 * self.nstations
+
+    def identity_gains(self) -> jnp.ndarray:
+        """Flat identity-Jones start/anchor: J = I for every
+        (cluster, station) — [1,0, 0,0, 0,0, 1,0] per station in the
+        solver's real packing (core.types.jones_to_params layout)."""
+        from sagecal_tpu.core.types import jones_to_params
+
+        eye = jnp.broadcast_to(
+            jnp.eye(2, dtype=jnp.result_type(self.data.vis)),
+            (self.nclusters, self.nstations, 2, 2),
+        )
+        return jones_to_params(eye).reshape(-1).astype(
+            jnp.real(self.data.vis).dtype)
+
+    def anchor(self) -> jnp.ndarray:
+        return (self.p_anchor if self.p_anchor is not None
+                else self.identity_gains())
+
+
+def cluster_coherencies(problem: RefineProblem, theta: jnp.ndarray):
+    """(M, F, 4, rows) complex coherency stack recomputed from the free
+    sky parameters — the differentiable analog of
+    ``solvers.sage.build_cluster_data``'s precomputed ``coh``."""
+    clusters, tables = problem.spec.apply(
+        theta, problem.clusters, problem.tables)
+    d = problem.data
+    cohs = []
+    for ci, src in enumerate(clusters):
+        tab = tables[ci] if tables is not None else None
+        cohs.append(predict_coherencies(
+            d.u, d.v, d.w, d.freqs, src, problem.fdelta,
+            problem.source_chunk, shapelets=tab,
+        ))
+    return jnp.stack(cohs, axis=0)
+
+
+def cluster_data_from_theta(problem: RefineProblem,
+                            theta: jnp.ndarray) -> ClusterData:
+    coh = cluster_coherencies(problem, theta)
+    M, _, _, rows = coh.shape
+    return ClusterData(
+        coh=coh,
+        chunk_map=jnp.zeros((M, rows), jnp.int32),
+        nchunk=jnp.ones((M,), jnp.int32),
+    )
+
+
+def residual_vec(problem: RefineProblem, p_flat: jnp.ndarray,
+                 theta: jnp.ndarray) -> jnp.ndarray:
+    """Masked residual as one flat REAL vector (re and im stacked) —
+    the shared residual of both bilevel levels, differentiable in both
+    arguments."""
+    d = problem.data
+    cdata = cluster_data_from_theta(problem, theta)
+    p = p_flat.reshape(problem.nclusters, 1, 8 * problem.nstations)
+    model = predict_full_model(p, cdata, d)
+    diff = (d.vis - model) * d.mask[:, None, :]
+    return jnp.concatenate(
+        [jnp.real(diff).reshape(-1), jnp.imag(diff).reshape(-1)])
+
+
+def outer_cost(problem: RefineProblem, p_flat: jnp.ndarray,
+               theta: jnp.ndarray) -> jnp.ndarray:
+    """h(p, theta) = 0.5 ||r||^2 — the pure misfit the refinement
+    minimizes at the inner fixed point."""
+    r = residual_vec(problem, p_flat, theta)
+    return 0.5 * jnp.dot(r, r)
+
+
+def inner_cost(problem: RefineProblem, p_flat: jnp.ndarray,
+               theta: jnp.ndarray) -> jnp.ndarray:
+    """f(p, theta) = h + 0.5 ridge ||p - anchor||^2 — the calibration
+    objective whose fixed point defines p*(theta)."""
+    dp = p_flat - problem.anchor()
+    return (outer_cost(problem, p_flat, theta)
+            + 0.5 * problem.ridge * jnp.dot(dp, dp))

@@ -1,0 +1,135 @@
+"""The outer refinement loop: LBFGS over sky parameters around the
+inner calibration solve.
+
+Host-driven by design: each outer iteration is ONE ``lbfgs_fit`` step
+(``itmax=1``) with the :class:`~sagecal_tpu.solvers.lbfgs.LBFGSMemory`
+carried across calls — the same persistent-curvature idiom the
+minibatch solver uses — so the host loop can emit a per-iteration
+refine trace, checkpoint the full outer state (theta + memory) at
+every iteration boundary, and stop/resume anywhere.  The expensive
+part, the bilevel value-and-grad (inner GN solve + IFT adjoint or
+unrolled backprop), is jitted once per run with the warm-start gains
+as a traced argument, so iterating never recompiles.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from sagecal_tpu.refine.implicit import make_inner_solver
+from sagecal_tpu.refine.objective import RefineProblem, outer_cost
+from sagecal_tpu.solvers.lbfgs import LBFGSMemory, lbfgs_fit
+
+
+class RefineResult(NamedTuple):
+    theta: jnp.ndarray  # refined sky parameters (flat, SkySpec layout)
+    p: jnp.ndarray  # inner gains at the final theta, flat (M*8N,)
+    cost: float  # outer misfit at the final theta
+    gradnorm: float
+    iterations: int  # outer iterations actually run
+    trace: List[dict]  # one entry per outer iteration
+    memory: LBFGSMemory  # outer curvature (resume carry)
+
+
+def make_outer_value_and_grad(problem: RefineProblem, **inner_kwargs):
+    """(solve, vg, cost): jitted ``solve(theta, p0) -> p*``,
+    ``vg(theta, p0) -> (h, dh/dtheta)`` with gradients through the
+    inner fixed point, and the cost-only entry for line searches."""
+    solve = make_inner_solver(problem, **inner_kwargs)
+
+    def outer_fn(theta, p0):
+        pstar = solve(theta, p0)
+        return outer_cost(problem, pstar, theta)
+
+    return (jax.jit(solve), jax.jit(jax.value_and_grad(outer_fn)),
+            jax.jit(outer_fn))
+
+
+def run_refine(
+    problem: RefineProblem,
+    theta0: Optional[jnp.ndarray] = None,
+    outer_iters: int = 10,
+    lbfgs_m: int = 7,
+    gradient: str = "implicit",
+    inner_iters: int = 12,
+    cg_iters: int = 32,
+    damping: float = 1e-6,
+    adjoint_cg_iters: int = 64,
+    adjoint_matvec: str = "hvp",
+    warm_start: bool = True,
+    tol: float = 0.0,
+    p_start: Optional[jnp.ndarray] = None,
+    memory: Optional[LBFGSMemory] = None,
+    start_iter: int = 0,
+    on_iteration: Optional[Callable[[int, jnp.ndarray, LBFGSMemory,
+                                     jnp.ndarray, dict], None]] = None,
+    fns=None,
+) -> RefineResult:
+    """Refine the free sky parameters by outer LBFGS.
+
+    ``on_iteration(it, theta, memory, p_warm, entry)`` fires after
+    every outer iteration — the refine app's checkpoint/trace hook.
+    ``p_start``/``memory``/``start_iter`` are the resume carries (pass
+    the values recovered from a checkpoint to continue a run).
+    ``warm_start`` feeds each iteration's converged inner gains as the
+    next iteration's inner start point (elastic warm-start idiom);
+    the gradient stays exact either way — the IFT adjoint only needs
+    the fixed point actually reached.
+    ``tol > 0`` stops early once the outer gradient norm falls below
+    it.
+    ``fns`` — an existing ``(solve, vg, cost_only)`` triple from
+    :func:`make_outer_value_and_grad`; reusing one across several
+    ``run_refine`` calls on the same problem skips their recompiles
+    (the inner/adjoint kwargs are ignored in that case)."""
+    if theta0 is None:
+        theta0 = problem.spec.theta0(problem.clusters, problem.tables)
+    theta = jnp.asarray(theta0)
+    p_warm = (jnp.asarray(p_start) if p_start is not None
+              else problem.identity_gains())
+    mem = (memory if memory is not None
+           else LBFGSMemory.init(theta.shape[0], lbfgs_m, theta.dtype))
+    solve, vg, cost_only = fns if fns is not None else (
+        make_outer_value_and_grad(
+            problem, iters=inner_iters, cg_iters=cg_iters,
+            damping=damping, gradient=gradient,
+            adjoint_cg_iters=adjoint_cg_iters,
+            adjoint_matvec=adjoint_matvec))
+
+    trace: List[dict] = []
+    cost = gradnorm = float("nan")
+    it = start_iter
+    for it in range(start_iter, outer_iters):
+        p0 = p_warm
+
+        def vg_fn(th, _p0=p0):
+            return vg(th, _p0)
+
+        def cost_fn(th, _p0=p0):
+            return cost_only(th, _p0)
+
+        res = lbfgs_fit(cost_fn, None, theta, itmax=1, M=lbfgs_m,
+                        memory=mem, vg_fn=vg_fn)
+        theta, mem = res.p, res.memory
+        cost, gradnorm = float(res.cost), float(res.gradnorm)
+        pstar = solve(theta, p0)
+        if warm_start:
+            p_warm = pstar
+        entry = {
+            "iter": it,
+            "cost": cost,
+            "gradnorm": gradnorm,
+            "theta": np.asarray(theta).tolist(),
+        }
+        trace.append(entry)
+        if on_iteration is not None:
+            on_iteration(it, theta, mem, p_warm, entry)
+        if tol > 0.0 and gradnorm < tol:
+            break
+    pstar = solve(theta, p_warm)
+    return RefineResult(theta=theta, p=pstar, cost=cost,
+                        gradnorm=gradnorm, iterations=it + 1 - start_iter,
+                        trace=trace, memory=mem)

@@ -1,0 +1,142 @@
+"""Free sky-parameter specification for differentiable refinement.
+
+The refinement subsystem optimizes a FLAT real vector ``theta`` over a
+caller-chosen subset of sky-model parameters — per-source fluxes,
+spectral indices, positions, shapelet mode coefficients — while the
+rest of the sky stays frozen at its catalog values.  :class:`SkySpec`
+is the static (hashable, non-pytree) description of which parameters
+are free; it packs the current cluster list into ``theta`` and applies
+a ``theta`` back onto the clusters with pure functional updates
+(``.at[].set``), so the whole application is differentiable and the
+cluster structure (source counts, types, shapelet tables) never
+changes shape under the optimizer.
+
+The reference C pipeline cannot express any of this: its coherencies
+are precomputed constants (predict.c) and no gradient path exists from
+residuals to the sky catalog.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from sagecal_tpu.ops.rime import ShapeletTable, SourceBatch
+
+
+class SkySpec:
+    """Which sky parameters are free, as static (cluster, source) keys.
+
+    - ``flux``: entries ``(cluster, source)`` — free ``sI0`` values;
+    - ``spec``: entries ``(cluster, source)`` — free spectral indices
+      (``spec_idx``; note the reference's si==0 gate in
+      ``_spectral_flux``: a freed spectral index that passes exactly
+      through 0 kinks the model there, so seed it nonzero);
+    - ``pos``: entries ``(cluster, source)`` — free (ll, mm) pairs
+      (``nn`` is recomputed, staying on the celestial sphere);
+    - ``modes``: entries ``(cluster, flat_mode_index)`` — free shapelet
+      coefficients of that cluster's table row 0 (single-model tables,
+      the fixture/diffuse-cluster case).
+
+    ``theta`` layout is the concatenation [flux, spec, ll, mm, modes]
+    in the order the keys were given.
+    """
+
+    def __init__(
+        self,
+        flux: Sequence[Tuple[int, int]] = (),
+        spec: Sequence[Tuple[int, int]] = (),
+        pos: Sequence[Tuple[int, int]] = (),
+        modes: Sequence[Tuple[int, int]] = (),
+    ):
+        self.flux = tuple((int(c), int(s)) for c, s in flux)
+        self.spec = tuple((int(c), int(s)) for c, s in spec)
+        self.pos = tuple((int(c), int(s)) for c, s in pos)
+        self.modes = tuple((int(c), int(m)) for c, m in modes)
+
+    @property
+    def nparams(self) -> int:
+        return (len(self.flux) + len(self.spec) + 2 * len(self.pos)
+                + len(self.modes))
+
+    def __repr__(self):  # stable key for config fingerprints
+        return (f"SkySpec(flux={self.flux}, spec={self.spec}, "
+                f"pos={self.pos}, modes={self.modes})")
+
+    # ------------------------------------------------------------ pack
+
+    def theta0(
+        self,
+        clusters: List[SourceBatch],
+        tables: Optional[List[Optional[ShapeletTable]]] = None,
+        dtype=None,
+    ) -> jnp.ndarray:
+        """Current values of the free parameters as the flat start
+        vector (the refinement start point — typically the perturbed
+        catalog)."""
+        vals = []
+        for c, s in self.flux:
+            vals.append(clusters[c].sI0[s])
+        for c, s in self.spec:
+            vals.append(clusters[c].spec_idx[s])
+        for c, s in self.pos:
+            vals.append(clusters[c].ll[s])
+        for c, s in self.pos:
+            vals.append(clusters[c].mm[s])
+        for c, m in self.modes:
+            if tables is None or tables[c] is None:
+                raise ValueError(
+                    f"SkySpec frees shapelet mode {m} of cluster {c} "
+                    f"but that cluster has no ShapeletTable")
+            vals.append(tables[c].modes[0, m])
+        if not vals:
+            raise ValueError("SkySpec frees no parameters")
+        th = jnp.stack(vals)
+        return th.astype(dtype) if dtype is not None else th
+
+    # ----------------------------------------------------------- apply
+
+    def apply(
+        self,
+        theta: jnp.ndarray,
+        clusters: List[SourceBatch],
+        tables: Optional[List[Optional[ShapeletTable]]] = None,
+    ) -> Tuple[List[SourceBatch], Optional[List[Optional[ShapeletTable]]]]:
+        """Clusters/tables with the free parameters replaced by
+        ``theta`` (functional ``.at[].set`` updates — differentiable
+        w.r.t. ``theta``)."""
+        out = list(clusters)
+        out_t = list(tables) if tables is not None else None
+        j = 0
+        for c, s in self.flux:
+            out[c] = out[c].replace(
+                sI0=out[c].sI0.at[s].set(theta[j].astype(out[c].sI0.dtype)))
+            j += 1
+        for c, s in self.spec:
+            out[c] = out[c].replace(
+                spec_idx=out[c].spec_idx.at[s].set(
+                    theta[j].astype(out[c].spec_idx.dtype)))
+            j += 1
+        npos = len(self.pos)
+        for i, (c, s) in enumerate(self.pos):
+            ll = theta[j + i].astype(out[c].ll.dtype)
+            mm = theta[j + npos + i].astype(out[c].mm.dtype)
+            nn = jnp.sqrt(jnp.maximum(1.0 - ll**2 - mm**2, 0.0)) - 1.0
+            out[c] = out[c].replace(
+                ll=out[c].ll.at[s].set(ll),
+                mm=out[c].mm.at[s].set(mm),
+                nn=out[c].nn.at[s].set(nn.astype(out[c].nn.dtype)),
+            )
+        j += 2 * npos
+        for c, m in self.modes:
+            if out_t is None or out_t[c] is None:
+                raise ValueError(
+                    f"SkySpec frees shapelet mode {m} of cluster {c} "
+                    f"but that cluster has no ShapeletTable")
+            tab = out_t[c]
+            out_t[c] = tab.replace(
+                modes=tab.modes.at[0, m].set(
+                    theta[j].astype(tab.modes.dtype)))
+            j += 1
+        return out, out_t

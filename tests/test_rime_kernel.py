@@ -700,3 +700,44 @@ def test_donated_lbfgs_entry_bit_identical_and_consumes_input():
     # the donated buffer is gone; the undonated one survives
     assert p_don.is_deleted()
     assert not p_ref.is_deleted()
+
+
+def test_sky_gradient_fails_loudly():
+    """Gradients w.r.t. the coherency stack through the chunked fused
+    wrappers must raise FusedSkyGradientError — never return silent
+    zeros (the backward only emits gain-table cotangents; sky-model
+    refinement must route through the XLA predict path)."""
+    from sagecal_tpu.ops.rime_kernel import (
+        FUSED_COHERENCY_COTANGENT,
+        FusedSkyGradientError,
+        fused_cost_packed_chunked,
+        fused_predict_packed_chunked,
+    )
+
+    assert FUSED_COHERENCY_COTANGENT is False
+    jones, coh, ant_p, ant_q, coh_ri, antp, antq, mp, rowsp = _random_problem(
+        seed=7
+    )
+    tab_re, tab_im = pack_gain_tables(jnp.asarray(jones), mp)
+    args = (jnp.asarray(antp), jnp.asarray(antq))
+    coh_j = jnp.asarray(coh_ri)
+
+    # gain gradients still work (guard must not affect them)
+    g = jax.grad(lambda a: jnp.sum(
+        fused_predict_packed_chunked(a, tab_im, coh_j, *args, TILE,
+                                     rowsp) ** 2))(tab_re)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+    with pytest.raises(FusedSkyGradientError):
+        jax.grad(lambda c: jnp.sum(
+            fused_predict_packed_chunked(tab_re, tab_im, c, *args, TILE,
+                                         rowsp) ** 2))(coh_j)
+
+    vis_ri = jnp.asarray(
+        np.random.default_rng(8).standard_normal(
+            (coh.shape[1], 8, rowsp)), jnp.float32)
+    mask_p = jnp.ones((coh.shape[1], rowsp), jnp.float32)
+    with pytest.raises(FusedSkyGradientError):
+        jax.grad(lambda c: fused_cost_packed_chunked(
+            tab_re, tab_im, c, *args, vis_ri, mask_p, 5.0, TILE,
+            rowsp))(coh_j)

@@ -223,3 +223,45 @@ print("serve smoke ok:", len(res), "requests,", sorted(buckets))
 PY
 [ $? = 0 ] || { echo "serve smoke validate FAILED"; exit 1; }
 rm -rf "$SRVDIR"
+echo "=== refine smoke (CPU, bilevel flux recovery)"
+# sky-model refinement end to end: 3 outer LBFGS steps over a
+# 15%-perturbed source flux, through the inner gain solve, must come
+# back to <1% relative error (f64 CPU — the regime the gradient
+# acceptance bounds are pinned in; tests/test_refine.py)
+RFDIR=$(mktemp -d)
+JAX_PLATFORMS=cpu timeout 480 python -m sagecal_tpu.apps.cli refine \
+  --synthetic 5 --outer-iters 3 --seed 3 -o "$RFDIR/r" \
+  || { echo "refine smoke FAILED rc=$?"; exit 1; }
+JAX_PLATFORMS=cpu timeout 60 python -c "
+import json
+s = json.load(open('$RFDIR/r.json'))
+assert s['flux_err'] is not None and s['flux_err'] < 0.01, s
+print('refine smoke ok: flux_err %.2e in %d outer iters (%.2f it/s)'
+      % (s['flux_err'], s['outer_iters'], s['outer_iters_per_sec']))" \
+  || { echo "refine smoke validate FAILED"; exit 1; }
+# the fused objective must REFUSE sky gradients, not silently zero them
+JAX_PLATFORMS=cpu timeout 120 python -m sagecal_tpu.apps.cli refine \
+  --synthetic 5 --outer-iters 1 -o "$RFDIR/rf" --fused 2>/dev/null \
+  && { echo "refine --fused did not refuse - stop"; exit 1; }
+rm -rf "$RFDIR"
+echo "=== spatial smoke (CPU, kill-and-resume through the band solves)"
+# the spatial workload with preemption: SIGTERM after the first band
+# checkpoint, --resume to completion, then require the FISTA spatial
+# model to actually explain the consensus solutions
+SPDIR=$(mktemp -d)
+SPRUN=(python -m sagecal_tpu.apps.cli spatial --synthetic 3 --nstations 7
+       --seed 5 -o "$SPDIR/sp" --checkpoint-every 1
+       --checkpoint-dir "$SPDIR/ckpt")
+JAX_PLATFORMS=cpu timeout 480 python -m sagecal_tpu.elastic.faultinject \
+  kill-at-ckpt 1 "$SPDIR/ckpt" -- "${SPRUN[@]}" \
+  || { echo "spatial kill step FAILED"; exit 1; }
+JAX_PLATFORMS=cpu timeout 480 "${SPRUN[@]}" --resume \
+  || { echo "spatial resume FAILED rc=$?"; exit 1; }
+JAX_PLATFORMS=cpu timeout 60 python -c "
+import json
+s = json.load(open('$SPDIR/sp.json'))
+assert s['bands'] == 3 and s['fista_fit_rel'] < 0.05, s
+print('spatial smoke ok: k_aic=%d k_mdl=%d fista fit %.2e nnz=%d'
+      % (s['k_aic'], s['k_mdl'], s['fista_fit_rel'], s['fista_nnz']))" \
+  || { echo "spatial smoke validate FAILED"; exit 1; }
+rm -rf "$SPDIR"
