@@ -1083,6 +1083,15 @@ def main():
         elog.close()
         unregister_event_log(elog)
     close_tracer()
+    # every mode (TPU, CPU fallback, fused or xla) appends one row to
+    # BENCH_HISTORY.jsonl so `diag serve` can render trend deltas;
+    # history is an append-only convenience, never fatal
+    try:
+        from sagecal_tpu.obs.perf import append_bench_history
+
+        append_bench_history(rec)
+    except Exception as e:  # noqa: BLE001 — read-only FS, odd cwd, ...
+        print(f"bench history append skipped: {e}", file=sys.stderr)
     # success path only: leaves the final "closed" heartbeat; a crash
     # keeps the recorder alive for the excepthook's dump
     close_flight_recorder()

@@ -222,3 +222,6 @@ class ServeConfig:
     checkpoint_dir: Optional[str] = None
     use_f64: bool = True
     verbose: bool = False
+    # per-tenant SLO specs (obs/slo.py): path to a slo.json; empty
+    # falls back to any "slos" key inside the request manifest
+    slo: str = ""

@@ -52,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--resume", action="store_true",
                     help="skip requests a previous (preempted) server "
                     "run already completed (per-tenant checkpoints)")
+    ap.add_argument("--slo", default="",
+                    help="per-tenant SLO specs (slo.json; obs/slo.py). "
+                    "Report-only: burn-rate alerts + serve_slo_* gauges; "
+                    "falls back to a 'slos' key in the request manifest")
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("-V", "--verbose", action="store_true")
@@ -68,7 +72,7 @@ def config_from_args(args) -> ServeConfig:
         abort_on_divergence=args.abort_on_divergence,
         resume=args.resume, checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir, use_f64=not args.f32,
-        verbose=args.verbose)
+        verbose=args.verbose, slo=args.slo)
 
 
 def run_serve(cfg: ServeConfig, requests=None, log=print):
@@ -100,6 +104,7 @@ def _run_serve_host(cfg: ServeConfig, requests, log, accel):
         unregister_event_log,
     )
     from sagecal_tpu.obs.perf import emit_perf_events
+    from sagecal_tpu.obs.trace import close_tracer, configure_tracer
     from sagecal_tpu.serve.request import load_requests
     from sagecal_tpu.serve.service import CalibrationService
 
@@ -114,10 +119,14 @@ def _run_serve_host(cfg: ServeConfig, requests, log, accel):
     if elog is not None:
         register_event_log(elog)
     get_flight_recorder(run_id=manifest.run_id)
+    # request-lifecycle tracing (SAGECAL_TRACE=1): run-level spans join
+    # the event stream on run_id; each request writes its own trace
+    configure_tracer(run_id=manifest.run_id)
     service = CalibrationService(cfg, log=log, device=accel)
     try:
         summary = service.run(requests, elog=elog)
     finally:
+        close_tracer()
         if elog is not None:
             emit_perf_events(elog)
             elog.close()

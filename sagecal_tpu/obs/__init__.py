@@ -77,11 +77,32 @@ from sagecal_tpu.obs.contracts import (  # noqa: F401
 )
 from sagecal_tpu.obs.perf import (  # noqa: F401
     TransferAudit,
+    append_bench_history,
+    bench_trend,
     device_memory_snapshot,
     dump_memory_profile,
     emit_perf_events,
     instrumented_jit,
+    read_bench_history,
     record_memory_watermark,
+)
+from sagecal_tpu.obs.aggregate import (  # noqa: F401
+    check_lifecycle,
+    dedupe_snapshots,
+    fleet_view,
+    lifecycle_report,
+    merge_states,
+    metrics_snapshot_path,
+    quantile_bounds_from_state,
+    read_metrics_snapshots,
+    write_metrics_snapshot,
+)
+from sagecal_tpu.obs.slo import (  # noqa: F401
+    SLOMonitor,
+    SLOSpec,
+    evaluate_results,
+    format_slo_report,
+    load_slo_specs,
 )
 
 # obs.quality names resolve lazily (PEP 562): the module needs numpy,
@@ -145,9 +166,26 @@ __all__ = [
     "drain_contract_events",
     "emit_contract_events",
     "TransferAudit",
+    "append_bench_history",
+    "bench_trend",
     "device_memory_snapshot",
     "dump_memory_profile",
     "emit_perf_events",
     "instrumented_jit",
+    "read_bench_history",
     "record_memory_watermark",
+    "check_lifecycle",
+    "dedupe_snapshots",
+    "fleet_view",
+    "lifecycle_report",
+    "merge_states",
+    "metrics_snapshot_path",
+    "quantile_bounds_from_state",
+    "read_metrics_snapshots",
+    "write_metrics_snapshot",
+    "SLOMonitor",
+    "SLOSpec",
+    "evaluate_results",
+    "format_slo_report",
+    "load_slo_specs",
 ]

@@ -347,6 +347,183 @@ def _cmd_quality(args) -> int:
     return 0
 
 
+def _fmt_bound(b) -> str:
+    lo, hi = b
+    return f"[{lo:.3g}, {hi:.3g}]"
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    import math
+
+    rank = min(len(sorted_vals),
+               max(1, math.ceil(q * len(sorted_vals) - 1e-9)))
+    return sorted_vals[rank - 1]
+
+
+def _cmd_serve(args) -> int:
+    """Fleet-view serve report: per-tenant/per-bucket latency tables
+    (exact percentiles from manifests + merged-histogram bounds), cache
+    hit ratio, queue-depth timeline, lifecycle completeness, SLO budget
+    status and bench trends.  Exit 1 on a burning SLO, an incomplete
+    lifecycle (when spans were provided), or nothing to report."""
+    from sagecal_tpu.obs.aggregate import (
+        fleet_view,
+        lifecycle_report,
+        queue_depth_timeline,
+        quantile_bounds_from_state,
+        state_counter_total,
+        state_label_values,
+    )
+    from sagecal_tpu.obs.perf import (
+        bench_trend,
+        format_bench_trend,
+        read_bench_history,
+    )
+    from sagecal_tpu.obs.slo import (
+        evaluate_results,
+        format_slo_report,
+        load_slo_specs,
+    )
+
+    out_dirs = list(args.out_dir)
+    view = fleet_view(
+        out_dirs,
+        event_paths=args.events or (),
+        span_paths=args.spans or (),
+    )
+    results = view["results"]
+    state = view["state"]
+    if not results and not state.get("counters"):
+        print("no result manifests or metric snapshots under: "
+              + ", ".join(out_dirs), file=sys.stderr)
+        return 1
+    rc = 0
+    print(f"serve fleet view: {len(results)} requests, "
+          f"{view['snapshots']} worker snapshot(s), "
+          f"{len(view['spans'])} spans")
+
+    # -- per-tenant latency table: exact from manifests, bounds from
+    # the merged cross-process histograms
+    by_tenant: dict = {}
+    for r in results:
+        by_tenant.setdefault(str(r.get("tenant", "?")), []).append(r)
+    qs = (0.5, 0.95, 0.99)
+    print("\nper-tenant latency (exact from manifests; [lo, hi] = "
+          "merged-histogram quantile bounds):")
+    print(f"{'tenant':<16s}{'n':>5s}{'ok':>5s}{'div':>5s}"
+          f"{'p50':>9s}{'p95':>9s}{'p99':>9s}  histogram bounds")
+    tenants = sorted(set(by_tenant)
+                     | set(state_label_values(
+                         state, "serve_request_latency_seconds",
+                         "tenant")))
+    for t in tenants:
+        rs = by_tenant.get(t, [])
+        lats = sorted(float(r.get("latency_s", 0.0)) for r in rs)
+        ok = sum(1 for r in rs if r.get("verdict") == "ok")
+        bounds = quantile_bounds_from_state(
+            state, "serve_request_latency_seconds", qs, tenant=t)
+        btxt = " ".join(
+            f"p{int(q * 100)}={_fmt_bound(bounds[q])}"
+            for q in qs if q in bounds) or "(no snapshot)"
+        print(f"{t:<16s}{len(rs):>5d}{ok:>5d}{len(rs) - ok:>5d}"
+              f"{_percentile(lats, 0.5):>9.3f}"
+              f"{_percentile(lats, 0.95):>9.3f}"
+              f"{_percentile(lats, 0.99):>9.3f}  {btxt}")
+
+    # -- per-bucket table + cache hit ratio
+    by_bucket: dict = {}
+    for r in results:
+        by_bucket.setdefault(str(r.get("bucket", "?")), []).append(r)
+    if by_bucket:
+        print("\nper-bucket:")
+        print(f"{'bucket':<28s}{'n':>5s}{'p50_s':>9s}{'max_s':>9s}")
+        for b in sorted(by_bucket):
+            lats = sorted(float(r.get("latency_s", 0.0))
+                          for r in by_bucket[b])
+            print(f"{b:<28s}{len(lats):>5d}"
+                  f"{_percentile(lats, 0.5):>9.3f}{lats[-1]:>9.3f}")
+    hits = state_counter_total(
+        state, "serve_executable_cache_hits_total")
+    misses = state_counter_total(
+        state, "serve_executable_cache_misses_total")
+    if hits or misses:
+        total = hits + misses
+        print(f"\nexecutable cache: {hits:g} hits / {misses:g} misses "
+              f"({hits / total:.1%} hit ratio, fleet-wide)")
+
+    # -- queue-depth timeline from manifests alone
+    line = queue_depth_timeline(results, max_points=args.timeline_points)
+    if line:
+        peak = max(d for _, d in line)
+        print(f"\nqueue depth timeline (from manifests; peak {peak}):")
+        width = 40
+        for t, d in line:
+            bar = "#" * int(width * d / max(peak, 1))
+            print(f"  t+{t:8.2f}s {d:>4d} {bar}")
+
+    # -- lifecycle completeness (when spans are available)
+    if view["spans"]:
+        lr = lifecycle_report(view["spans"], results)
+        print(f"\nlifecycle traces: {lr['complete']}/{lr['traces']} "
+              f"complete ({lr['compile_traces']} compile, "
+              f"{lr['cache_hit_traces']} cache-hit), "
+              f"{lr['manifests_matched']}/{lr['manifests_with_trace']} "
+              f"manifests matched to a complete trace")
+        for p in lr["manifest_problems"][:10]:
+            print(f"  INCOMPLETE: {p}")
+        if not lr["ok"]:
+            rc = 1
+
+    # -- SLO budget status (burning -> nonzero exit, mirroring
+    # `diag quality`'s divergence verdict)
+    specs = {}
+    if args.slo:
+        specs = load_slo_specs(args.slo)
+    if specs:
+        evals = evaluate_results(specs, results)
+        print("\nSLO budget status:")
+        print(format_slo_report(evals))
+        for ev in evals:
+            if ev["burning"]:
+                print(f"SLO BURNING: tenant {ev['tenant']} burn rates "
+                      f"{['%.2f' % b for b in ev['burn_rates']]} over "
+                      f"windows {ev['windows_s']}s", file=sys.stderr)
+                rc = 1
+
+    # -- bench trend over the last K history rows
+    hist = read_bench_history(args.bench_history)
+    if hist:
+        trend = bench_trend(hist, last_k=args.last_k)
+        print(f"\nbench trend (last {args.last_k} comparable of "
+              f"{len(hist)} runs):")
+        print(format_bench_trend(trend))
+
+    if args.report:
+        doc = {
+            "requests": len(results),
+            "snapshots": view["snapshots"],
+            "tenants": {
+                t: {
+                    "n": len(by_tenant.get(t, [])),
+                    "ok": sum(1 for r in by_tenant.get(t, [])
+                              if r.get("verdict") == "ok"),
+                }
+                for t in tenants
+            },
+            "cache": {"hits": hits, "misses": misses},
+            "slo": evaluate_results(specs, results) if specs else [],
+            "exit": rc,
+        }
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=True, default=float)
+            f.write("\n")
+        print(f"\nreport -> {args.report}")
+    print("\nSERVE: " + ("UNHEALTHY" if rc else "OK"))
+    return rc
+
+
 def _cmd_trace(args) -> int:
     from sagecal_tpu.obs.trace import (
         format_trace_report,
@@ -438,6 +615,36 @@ def build_parser() -> argparse.ArgumentParser:
     gp.add_argument("--strict", action="store_true",
                     help="compare even across a platform mismatch")
     gp.set_defaults(fn=_cmd_gate)
+
+    sp = sub.add_parser(
+        "serve",
+        help="fleet serve report: latency/SLO/cache/lifecycle across "
+             "worker out-dirs (exit 1 on burning SLO)",
+    )
+    sp.add_argument("out_dir", nargs="+",
+                    help="serve --out-dir(s): result manifests + "
+                         "metrics-*.json worker snapshots")
+    sp.add_argument("--events", action="append", default=None,
+                    metavar="FILE_OR_DIR",
+                    help="JSONL event log(s) to fold in (repeatable)")
+    sp.add_argument("--spans", action="append", default=None,
+                    metavar="FILE_OR_DIR",
+                    help="span JSONL(s) from SAGECAL_TRACE runs "
+                         "(repeatable); enables lifecycle completeness "
+                         "audit")
+    sp.add_argument("--slo", default="",
+                    help="slo.json (or request manifest with a 'slos' "
+                         "key); burning tenant -> exit 1")
+    sp.add_argument("--bench-history", default=None,
+                    help="BENCH_HISTORY.jsonl (default: "
+                         "$SAGECAL_BENCH_HISTORY or ./BENCH_HISTORY.jsonl)")
+    sp.add_argument("--last-k", type=int, default=5,
+                    help="bench-trend window (default 5)")
+    sp.add_argument("--timeline-points", type=int, default=24,
+                    help="max rows in the queue-depth timeline")
+    sp.add_argument("--report", default=None,
+                    help="also write a machine-readable JSON report")
+    sp.set_defaults(fn=_cmd_serve)
 
     qp = sub.add_parser(
         "quality",

@@ -45,6 +45,7 @@ selection.
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import sys
 import tempfile
@@ -966,4 +967,140 @@ def format_gate_report(rows, failures) -> str:
     else:
         lines.append("no comparable metrics between the two records")
     lines.append("GATE: " + ("FAIL" if failures else "PASS"))
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------- bench history
+
+# one line per bench run, forever: the perf trajectory the single-slot
+# BENCH_BASELINE.json diff cannot hold.  Schema-versioned JSONL next to
+# the repo root (or SAGECAL_BENCH_HISTORY); `diag serve` renders trend
+# deltas over the last K rows against the gate direction tables above.
+BENCH_HISTORY_SCHEMA_VERSION = 1
+DEFAULT_BENCH_HISTORY = "BENCH_HISTORY.jsonl"
+
+
+def bench_history_path(path: Optional[str] = None) -> str:
+    return path or os.environ.get("SAGECAL_BENCH_HISTORY") \
+        or DEFAULT_BENCH_HISTORY
+
+
+def _git_rev() -> str:
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        rev = out.stdout.strip()
+        return rev if out.returncode == 0 and rev else "unknown"
+    except Exception:
+        return "unknown"
+
+
+def append_bench_history(rec: dict, path: Optional[str] = None) -> str:
+    """Append one bench record to the history JSONL (single O_APPEND
+    write — concurrent bench runs never tear lines).  Stamps schema
+    version, wall-clock, git revision and a fingerprint of the bench
+    config so trend rows are only compared like-for-like.  Returns the
+    path written."""
+    from sagecal_tpu.elastic.checkpoint import config_fingerprint
+
+    path = bench_history_path(path)
+    cfg_keys = ("mode", "shape", "iters", "batch", "dtype", "backend",
+                "kernel", "device_kind", "platform")
+    row = {
+        "history_schema_version": BENCH_HISTORY_SCHEMA_VERSION,
+        "ts": time.time(),
+        "git_rev": _git_rev(),
+        "config_fingerprint": config_fingerprint(
+            **{k: rec.get(k) for k in cfg_keys if k in rec})[:16],
+    }
+    for k, v in rec.items():
+        row.setdefault(k, v)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+    try:
+        os.write(fd, (json.dumps(row, default=str) + "\n").encode("utf-8"))
+    finally:
+        os.close(fd)
+    return path
+
+
+def read_bench_history(path: Optional[str] = None) -> List[dict]:
+    """Every parseable row of the bench history, in file order (skips
+    corrupt lines like every other JSONL reader here)."""
+    path = bench_history_path(path)
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(row, dict):
+                out.append(row)
+    return out
+
+
+def bench_trend(history: List[dict], last_k: int = 5,
+                metrics: Optional[Tuple[str, ...]] = None) -> List[dict]:
+    """Trend deltas over the last K same-fingerprint runs: for each
+    metric present in the newest row, the oldest-in-window -> newest
+    ratio plus a direction verdict from the gate tables (``better`` /
+    ``worse`` / ``flat`` / ``info``)."""
+    if not history:
+        return []
+    newest = history[-1]
+    fp = newest.get("config_fingerprint")
+    window = [r for r in history
+              if r.get("config_fingerprint") == fp][-max(last_k, 2):]
+    if len(window) < 2:
+        return []
+    oldest = window[0]
+    names = metrics if metrics is not None else tuple(
+        m for m in GATE_DEFAULT_METRICS if m in newest)
+    out = []
+    for m in names:
+        a, b = oldest.get(m), newest.get(m)
+        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)) \
+                or isinstance(a, bool) or isinstance(b, bool) or a == 0:
+            continue
+        ratio = float(b) / float(a)
+        if m in GATE_LOWER_BETTER:
+            verdict = ("better" if ratio < 0.98
+                       else "worse" if ratio > 1.02 else "flat")
+        elif m in GATE_HIGHER_BETTER:
+            verdict = ("better" if ratio > 1.02
+                       else "worse" if ratio < 0.98 else "flat")
+        else:
+            verdict = "info"
+        out.append({
+            "metric": m, "first": float(a), "last": float(b),
+            "ratio": ratio, "runs": len(window), "verdict": verdict,
+            "first_rev": str(oldest.get("git_rev", "?")),
+            "last_rev": str(newest.get("git_rev", "?")),
+        })
+    return out
+
+
+def format_bench_trend(trend: List[dict]) -> str:
+    """Trend table for ``diag serve``."""
+    if not trend:
+        return "(no bench history trend: fewer than 2 comparable runs)"
+    w = max(len(t["metric"]) for t in trend) + 2
+    lines = [f"{'metric':<{w}}{'first':>14}{'last':>14}{'ratio':>8}"
+             f"{'runs':>6}  trend"]
+    for t in trend:
+        lines.append(
+            f"{t['metric']:<{w}}{t['first']:>14.6g}{t['last']:>14.6g}"
+            f"{t['ratio']:>8.3f}{t['runs']:>6}  {t['verdict']} "
+            f"({t['first_rev']} -> {t['last_rev']})")
     return "\n".join(lines)

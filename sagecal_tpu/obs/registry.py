@@ -17,6 +17,7 @@ need their own guards.
 from __future__ import annotations
 
 import bisect
+import math
 import os
 import threading
 from contextlib import contextmanager
@@ -95,6 +96,69 @@ class _Histogram:
         self.vmin = min(self.vmin, value)
         self.vmax = max(self.vmax, value)
 
+    def snapshot(self) -> dict:
+        """JSON-able full state (bucket edges included so shards from
+        different processes can be merged after the fact)."""
+        return {
+            "buckets": list(self.buckets),
+            "counts": list(self.counts),
+            "count": self.count,
+            "sum": self.total,
+            "min": self.vmin if self.count else None,
+            "max": self.vmax if self.count else None,
+        }
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "_Histogram":
+        h = cls(snap["buckets"])
+        counts = list(snap["counts"])
+        if len(counts) != len(h.counts):
+            raise ValueError(
+                f"histogram snapshot has {len(counts)} buckets, "
+                f"expected {len(h.counts)}")
+        h.counts = [int(c) for c in counts]
+        h.count = int(snap["count"])
+        h.total = float(snap["sum"])
+        if h.count:
+            h.vmin = float(snap["min"])
+            h.vmax = float(snap["max"])
+        return h
+
+    def merge(self, other: "_Histogram") -> None:
+        """Fold ``other`` into this histogram in place.  Bucket layouts
+        must match exactly — merging is only defined shard-by-shard over
+        the same metric."""
+        if self.buckets != other.buckets:
+            raise ValueError(
+                f"cannot merge histograms with different buckets: "
+                f"{self.buckets} vs {other.buckets}")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.total += other.total
+        self.vmin = min(self.vmin, other.vmin)
+        self.vmax = max(self.vmax, other.vmax)
+
+    def quantile_bounds(self, q: float) -> Optional[Tuple[float, float]]:
+        """Exact (lower, upper) bound on the q-quantile from bucket
+        counts alone.  The true quantile provably lies in the returned
+        closed interval; ``None`` when the histogram is empty."""
+        if self.count == 0:
+            return None
+        q = min(max(float(q), 0.0), 1.0)
+        rank = min(self.count, max(1, math.ceil(q * self.count - 1e-9)))
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= rank:
+                lo = self.buckets[i - 1] if i > 0 else float("-inf")
+                hi = self.buckets[i] if i < len(self.buckets) else float("inf")
+                # observed extremes tighten open-ended edges
+                lo = max(lo, self.vmin)
+                hi = min(hi, self.vmax)
+                return (lo, hi)
+        return (self.vmin, self.vmax)
+
 
 class MetricsRegistry:
     """Threadsafe counter/gauge/histogram store with Prometheus text
@@ -160,13 +224,62 @@ class MetricsRegistry:
                     out["gauges"][name + _fmt_labels(key)] = v
             for name, series in self._histograms.items():
                 for key, h in series.items():
-                    out["histograms"][name + _fmt_labels(key)] = {
-                        "count": h.count,
-                        "sum": h.total,
-                        "min": h.vmin if h.count else None,
-                        "max": h.vmax if h.count else None,
-                    }
+                    out["histograms"][name + _fmt_labels(key)] = h.snapshot()
             return out
+
+    def export_state(self) -> dict:
+        """Structured, JSON-able, label-preserving dump — the mergeable
+        counterpart of :meth:`snapshot`.  Labels are kept as explicit
+        ``[key, value]`` pairs (not flattened into a display string) so
+        another process can reconstruct the exact series and fold shards
+        together (see :mod:`sagecal_tpu.obs.aggregate`)."""
+        with self._lock:
+            return {
+                "schema_version": 1,
+                "counters": [
+                    {"name": name, "labels": [list(kv) for kv in key],
+                     "value": v}
+                    for name, series in self._counters.items()
+                    for key, v in series.items()
+                ],
+                "gauges": [
+                    {"name": name, "labels": [list(kv) for kv in key],
+                     "value": v}
+                    for name, series in self._gauges.items()
+                    for key, v in series.items()
+                ],
+                "histograms": [
+                    {"name": name, "labels": [list(kv) for kv in key],
+                     **h.snapshot()}
+                    for name, series in self._histograms.items()
+                    for key, h in series.items()
+                ],
+            }
+
+    def restore_state(self, state: dict) -> None:
+        """Fold an :meth:`export_state` document back into this registry
+        (used on ``--resume`` so counters stay monotonic across
+        preemptions).  Counters and histograms accumulate; gauges are
+        only restored where no fresher value exists."""
+        if not state:
+            return
+        with self._lock:
+            for ent in state.get("counters", ()):
+                key = tuple(tuple(kv) for kv in ent["labels"])
+                series = self._counters.setdefault(ent["name"], {})
+                series[key] = series.get(key, 0.0) + float(ent["value"])
+            for ent in state.get("gauges", ()):
+                key = tuple(tuple(kv) for kv in ent["labels"])
+                series = self._gauges.setdefault(ent["name"], {})
+                series.setdefault(key, float(ent["value"]))
+            for ent in state.get("histograms", ()):
+                key = tuple(tuple(kv) for kv in ent["labels"])
+                series = self._histograms.setdefault(ent["name"], {})
+                incoming = _Histogram.from_snapshot(ent)
+                if key in series:
+                    series[key].merge(incoming)
+                else:
+                    series[key] = incoming
 
     def to_prometheus(self) -> str:
         """Prometheus text exposition (scrape a long run by dumping this

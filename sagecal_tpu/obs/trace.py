@@ -178,6 +178,12 @@ class Tracer:
         stack = self._stack()
         return stack[-1] if stack else None
 
+    def allocate_span_id(self) -> str:
+        """Reserve a span id to hand out (e.g. embed in a result
+        manifest) before the span itself is recorded via
+        :meth:`add_span` with ``span_id=``."""
+        return self._new_span_id()
+
     def span(self, name: str, **attrs) -> _Span:
         """Context manager timing a nested span; attrs land in the
         record's ``attrs`` object."""
@@ -186,26 +192,34 @@ class Tracer:
     def add_span(self, name: str, seconds: float, *,
                  parent_id: Optional[str] = None,
                  start_unix: Optional[float] = None,
+                 trace_id: Optional[str] = None,
+                 span_id: Optional[str] = None,
                  **attrs) -> str:
         """Record an already-measured span (used for synthetic per-band
-        / per-round attribution children).  Returns the span id so
+        / per-round attribution children, and for serve's per-request
+        lifecycle chains).  ``trace_id`` overrides the tracer-wide id so
+        one process can write many logical traces (one per request);
+        ``span_id`` records under a previously
+        :meth:`allocate_span_id`-reserved id.  Returns the span id so
         callers can parent further children under it."""
-        span_id = self._new_span_id()
+        if span_id is None:
+            span_id = self._new_span_id()
         if start_unix is None:
             start_unix = time.time() - seconds
         if parent_id is None:
             parent_id = self.current_span_id()
         self._write_span(name, span_id, parent_id, start_unix,
-                         float(seconds), attrs)
+                         float(seconds), attrs, trace_id=trace_id)
         return span_id
 
     def _write_span(self, name: str, span_id: str,
                     parent_id: Optional[str], ts: float, dur: float,
-                    attrs: Dict[str, Any]) -> None:
+                    attrs: Dict[str, Any],
+                    trace_id: Optional[str] = None) -> None:
         rec = {
             "kind": "span",
             "schema_version": SPAN_SCHEMA_VERSION,
-            "trace_id": self.trace_id,
+            "trace_id": trace_id or self.trace_id,
             "span_id": span_id,
             "parent_id": parent_id,
             "name": name,
@@ -258,11 +272,14 @@ class NullTracer:
         return _NULL_SPAN
 
     def add_span(self, name, seconds, *, parent_id=None, start_unix=None,
-                 **attrs) -> None:
+                 trace_id=None, span_id=None, **attrs) -> None:
         return None
 
     def current_span_id(self) -> None:
         return None
+
+    def allocate_span_id(self) -> str:
+        return ""
 
     def close(self) -> None:
         pass

@@ -44,13 +44,21 @@ class ExecutableCache:
         """The executable wrapper for this bucket+numerics, creating it
         on first touch.  The returned callable has the
         ``sagefit_packed_batch`` signature and donates ``p0``."""
+        return self.get_with_status(bucket, fingerprint)[0]
+
+    def get_with_status(self, bucket: BucketSpec,
+                        fingerprint: str) -> Tuple[Callable, bool]:
+        """Like :meth:`get` but also reports whether the lookup hit
+        (``(fn, True)``) or built a fresh wrapper (``(fn, False)``) —
+        the serve lifecycle tracer names its span ``cache_hit`` vs
+        ``compile`` off this bit."""
         key = (bucket, fingerprint)
         with self._lock:
             fn = self._entries.get(key)
             if fn is not None:
                 self.hits += 1
                 self._count("hits", bucket)
-                return fn
+                return fn, True
             self.misses += 1
             self._count("misses", bucket)
             from sagecal_tpu.obs.perf import instrumented_jit
@@ -64,7 +72,7 @@ class ExecutableCache:
                 donate_argnames=("p0",),
             )
             self._entries[key] = fn
-            return fn
+            return fn, False
 
     def _count(self, kind: str, bucket: BucketSpec) -> None:
         try:

@@ -57,6 +57,10 @@ class SolveRequest:
     cluster_file: str = ""
     out_solutions: str = ""
     in_column: str = "vis"
+    # lifecycle trace id: carried through to the result manifest so one
+    # logical trace survives process boundaries and --resume; derived
+    # from the request_id when the submitter doesn't pick one
+    trace_id: str = ""
     # None = inherit the ServeConfig default
     solver_mode: Optional[int] = None
     max_emiter: Optional[int] = None
@@ -74,6 +78,8 @@ class SolveRequest:
                 f"{_ID_RE.pattern} (it names output files)")
         if not self.cluster_file:
             self.cluster_file = self.sky_model + ".cluster"
+        if not self.trace_id:
+            self.trace_id = f"req-{self.request_id}"
 
 
 def load_requests(path: str) -> List[SolveRequest]:

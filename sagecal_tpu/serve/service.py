@@ -80,7 +80,7 @@ class _Entry:
     """One loaded, solve-ready request."""
 
     __slots__ = ("req", "data", "cdata", "p0", "key", "scfg", "meta",
-                 "nclus", "nchunk_max")
+                 "nclus", "nchunk_max", "enqueued_at", "started_at")
 
     def __init__(self, req, data, cdata, p0, key, scfg, meta,
                  nclus, nchunk_max):
@@ -93,6 +93,9 @@ class _Entry:
         self.meta = meta
         self.nclus = nclus
         self.nchunk_max = nchunk_max
+        # request-lifecycle wall-clock marks (set by the scheduler)
+        self.enqueued_at = 0.0
+        self.started_at = 0.0
 
 
 class CalibrationService:
@@ -111,6 +114,7 @@ class CalibrationService:
         self._results: List[Dict[str, Any]] = []
         self._latencies: List[float] = []
         self._diverged_abort: Optional[tuple] = None
+        self._slo = None  # SLOMonitor, built in run() from cfg.slo
 
     # -- data loading --------------------------------------------------
 
@@ -163,13 +167,14 @@ class CalibrationService:
 
     def _dispatch(self, bucket: BucketSpec, fingerprint: str,
                   entries: List[_Entry], batch: int, elog,
-                  t_enqueue: float, padded_flush: bool) -> None:
+                  padded_flush: bool) -> None:
         """Stack ``entries`` into one vmapped solve; unpack each real
         lane into its request's solutions file + result manifest."""
         import jax
 
         idx, valid = pad_indices(len(entries), batch)
         k = len(entries)
+        t_pack = time.time()
 
         def stack(get):
             return jax.tree_util.tree_map(
@@ -184,11 +189,16 @@ class CalibrationService:
         keys = np.stack([entries[i].key for i in idx])
         scfg = entries[0].scfg
 
-        fn = self.cache.get(bucket, fingerprint)
+        fn, cache_hit = self.cache.get_with_status(bucket, fingerprint)
         args = (data_b, cdata_b, vis.real, vis.imag, coh.real, coh.imag,
                 p0, scfg, keys)
         if self.device is not None:
             args = jax.device_put(args, self.device)
+        pack_s = time.time() - t_pack
+        # compile time shows up inside the first call of the wrapper;
+        # split it out of execute via the perf-stats delta so the
+        # lifecycle's compile|cache_hit span is honest
+        compile_before = self._compile_seconds(fn)
         tic = time.time()
         out = fn(*args)
         # materialize on host before unpacking lanes (one sync)
@@ -198,6 +208,13 @@ class CalibrationService:
         div_host = np.asarray(out.diverged)
         nu_host = np.asarray(out.mean_nu)
         solve_s = time.time() - tic
+        compile_s = 0.0 if cache_hit else max(
+            self._compile_seconds(fn) - compile_before, 0.0)
+        timing = {
+            "t_pack": t_pack, "pack_s": pack_s, "t_exec": tic,
+            "solve_s": solve_s, "cache_hit": cache_hit,
+            "compile_s": min(compile_s, solve_s),
+        }
         if elog is not None:
             elog.emit("serve_batch_dispatched", bucket=bucket.short(),
                       fingerprint=fingerprint[:12], size=k,
@@ -214,17 +231,33 @@ class CalibrationService:
                 float(nu_host[lane]),
                 None if out.quality is None else jax.tree_util.tree_map(
                     lambda x: x[lane], out.quality),
-                elog, t_enqueue)
+                elog, timing)
+
+    @staticmethod
+    def _compile_seconds(fn) -> float:
+        """Cumulative compile seconds attributed to an instrumented-jit
+        wrapper (0.0 when perf stats are unavailable)."""
+        try:
+            from sagecal_tpu.obs.perf import perf_stats
+
+            name = getattr(fn, "name", None)
+            if not name:
+                return 0.0
+            return float(perf_stats().get(name, {}).get(
+                "compile_seconds", 0.0))
+        except Exception:
+            return 0.0
 
     def _finish_request(self, entry: _Entry, bucket, lane, batch,
                         p, res0, res1, diverged, mean_nu, quality,
-                        elog, t_enqueue) -> None:
+                        elog, timing) -> None:
         from sagecal_tpu.core.types import params_to_jones
         from sagecal_tpu.io import solutions as solio
         from sagecal_tpu.obs.quality import check_and_emit
         from sagecal_tpu.obs.registry import get_registry
 
         req, meta = entry.req, entry.meta
+        t_unpack = time.time()
         # divergence guard, same residual-ratio policy as fullbatch
         ratio_blown = (not np.isfinite(res1) or res1 == 0.0
                        or res1 > self.cfg.res_ratio * res0)
@@ -253,35 +286,119 @@ class CalibrationService:
                 meta.deltat * req.tilesz / 60.0, N, M, M * nchunk_max)
             solio.append_solutions(fh, jsol)
 
-        latency = time.time() - t_enqueue
-        self._latencies.append(latency)
+        from sagecal_tpu.obs.trace import get_tracer
+
+        tracer = get_tracer()
+        t_write = time.time()
+        queue_wait = max(entry.started_at - entry.enqueued_at, 0.0)
         result = {
             "request_id": req.request_id, "tenant": req.tenant,
             "dataset": req.dataset, "t0": req.t0, "tilesz": req.tilesz,
             "verdict": verdict, "reasons": reasons,
             "res_0": res0, "res_1": res1, "mean_nu": mean_nu,
             "bucket": bucket.short(), "batch": batch, "lane": lane,
-            "solutions": out_path, "latency_s": latency,
+            "solutions": out_path,
+            # wall-clock lifecycle: latency reconstructable from the
+            # manifest alone, no live gauges needed
+            "enqueued_at": entry.enqueued_at,
+            "started_at": entry.started_at,
+            "completed_at": t_write,
+            "queue_wait_s": queue_wait,
+            "latency_s": t_write - entry.enqueued_at,
+            "trace_id": req.trace_id,
         }
+        if tracer.enabled:
+            result["span_id"] = tracer.allocate_span_id()
         write_result_manifest(self.cfg.out_dir, result)
+        write_s = time.time() - t_write
+        latency = result["latency_s"]
+        self._latencies.append(latency)
+        if tracer.enabled:
+            self._emit_lifecycle(tracer, entry, bucket, lane, batch,
+                                 verdict, timing, t_unpack, t_write,
+                                 write_s, result["span_id"])
         self._results.append(result)
         reg = get_registry()
         reg.counter_inc("serve_requests_total", tenant=req.tenant,
                         verdict=verdict,
                         help="serve requests completed, by verdict")
-        reg.observe("serve_request_latency_seconds", latency,
-                    tenant=req.tenant,
+        reg.observe("serve_request_latency_seconds",
+                    result["latency_s"], tenant=req.tenant,
                     help="submit -> result-manifest latency")
+        reg.observe("serve_queue_wait_seconds", queue_wait,
+                    tenant=req.tenant,
+                    help="enqueue -> scheduler-pop wait")
+        if self._slo is not None and self._slo.enabled:
+            self._slo.observe(req.tenant, result["completed_at"],
+                              latency, verdict)
+            self._slo.evaluate(now=result["completed_at"], elog=elog,
+                               registry=reg)
         if elog is not None:
             elog.emit("request_done", **result)
         self.log(f"request {req.request_id} [{req.tenant}]: "
                  f"{verdict} residual {res0:.6f} -> {res1:.6f} "
                  f"(bucket {bucket.short()}, lane {lane}/{batch}, "
-                 f"{latency:.1f}s)")
+                 f"{result['latency_s']:.1f}s)")
         if verdict == "diverged" and self.cfg.abort_on_divergence \
                 and self._diverged_abort is None:
             # raised after the whole batch's manifests are on disk
             self._diverged_abort = (req.request_id, req.t0, reasons)
+
+    def _emit_lifecycle(self, tracer, entry: _Entry, bucket, lane,
+                        batch, verdict, timing, t_unpack, t_write,
+                        write_s, root_id) -> None:
+        """One trace per request: ``serve.request`` root spanning
+        enqueue -> manifest write, with the full phase chain as
+        children.  Batch-shared phases (pack/compile/execute) are
+        billed to every lane of the batch, marked ``shared`` with the
+        batch width, so per-request traces stay self-contained while
+        fleet totals divide by the batch attr.  The root records under
+        the pre-allocated ``root_id`` already written into the result
+        manifest — that is the pointer that lets a later process (or a
+        --resume continuation) join manifest and trace."""
+        req = entry.req
+        tid = req.trace_id
+        base = dict(request_id=req.request_id, tenant=req.tenant,
+                    bucket=bucket.short(), lane=lane, batch=batch)
+        # parent_id="" (not None) pins the root above any ambient span
+        # stack; readers treat missing/unknown parents as roots
+        tracer.add_span(
+            "serve.request", t_write + write_s - entry.enqueued_at,
+            parent_id="", start_unix=entry.enqueued_at, trace_id=tid,
+            span_id=root_id, verdict=verdict, **base)
+
+        def child(name, start, dur, **attrs):
+            tracer.add_span(name, max(dur, 0.0), parent_id=root_id,
+                            start_unix=start, trace_id=tid,
+                            **dict(base, **attrs))
+
+        child("enqueue", entry.enqueued_at,
+              entry.started_at - entry.enqueued_at)
+        child("schedule", entry.started_at,
+              timing["t_pack"] - entry.started_at)
+        child("pack", timing["t_pack"], timing["pack_s"], shared=True)
+        exec_s = timing["solve_s"] - timing["compile_s"]
+        if timing["cache_hit"]:
+            child("cache_hit", timing["t_pack"] + timing["pack_s"], 0.0)
+        else:
+            child("compile", timing["t_exec"], timing["compile_s"],
+                  shared=True)
+        child("execute", timing["t_exec"] + timing["compile_s"], exec_s,
+              shared=True)
+        child("unpack", t_unpack, t_write - t_unpack)
+        child("write_manifest", t_write, write_s)
+
+    def _build_slo_monitor(self):
+        """SLO specs from ``cfg.slo`` (a slo.json) or, failing that, a
+        top-level ``"slos"`` key inside the request manifest."""
+        from sagecal_tpu.obs.slo import SLOMonitor, load_slo_specs
+
+        specs = {}
+        if self.cfg.slo:
+            specs = load_slo_specs(self.cfg.slo)
+        elif self.cfg.requests and os.path.exists(self.cfg.requests):
+            specs = load_slo_specs(self.cfg.requests)
+        return SLOMonitor(specs)
 
     # -- the scheduler -------------------------------------------------
 
@@ -299,6 +416,7 @@ class CalibrationService:
         cfg, reg = self.cfg, get_registry()
         t_start = time.time()
         os.makedirs(cfg.out_dir, exist_ok=True)
+        self._slo = self._build_slo_monitor()
 
         # -- per-tenant elastic state: which requests already finished
         tenants = list(dict.fromkeys(r.tenant for r in requests))
@@ -307,6 +425,7 @@ class CalibrationService:
         ckmgrs: Dict[str, CheckpointManager] = {}
         done_flags: Dict[str, np.ndarray] = {}
         skipped = 0
+        resumed_metrics: List[tuple] = []  # (metrics_ts, state)
         for t in tenants:
             reqs = by_tenant[t]
             fp = config_fingerprint(
@@ -334,6 +453,11 @@ class CalibrationService:
                         skipped += n
                         self.log(f"resume[{t}]: {n}/{len(reqs)} "
                                  f"requests already served ({rpath})")
+                        if isinstance(rmeta, dict) \
+                                and rmeta.get("metrics"):
+                            resumed_metrics.append(
+                                (float(rmeta.get("metrics_ts", 0.0)),
+                                 rmeta["metrics"]))
                         if elog is not None:
                             for r, f in zip(reqs, flags):
                                 if f:
@@ -341,6 +465,12 @@ class CalibrationService:
                                               request_id=r.request_id,
                                               tenant=t)
             done_flags[t] = flags
+        if resumed_metrics and reg.enabled:
+            # every tenant checkpoint snapshots the whole process-wide
+            # registry, so restore only the NEWEST one: counters stay
+            # monotonic across the preemption without double-counting
+            _, state = max(resumed_metrics, key=lambda x: x[0])
+            reg.restore_state(state)
 
         # -- queues (post-resume) and double-buffered prefetch streams.
         # A stream is one (tenant, dataset, tilesz, column) request
@@ -350,6 +480,8 @@ class CalibrationService:
             t: collections.deque(
                 r for r, f in zip(by_tenant[t], done_flags[t]) if not f)
             for t in tenants}
+        enqueued_at = {r.request_id: time.time()
+                       for t in tenants for r in queues[t]}
         for t in tenants:
             reg.gauge_set("serve_queue_depth", len(queues[t]),
                           tenant=t,
@@ -386,17 +518,24 @@ class CalibrationService:
                      if r.request_id == entry.req.request_id)
             done_flags[t][i] = 1
             if t in ckmgrs:
+                extra = {}
+                if reg.enabled:
+                    # registry snapshot rides the elastic checkpoint:
+                    # a --resume restores it, so counters survive
+                    # preemptions instead of silently resetting
+                    extra = dict(metrics=reg.export_state(),
+                                 metrics_ts=time.time())
                 ckmgrs[t].update(
                     int(done_flags[t].sum()) - 1,
                     {"done": done_flags[t]},
                     requests_done=int(done_flags[t].sum()),
-                    tenant=t)
+                    tenant=t, **extra)
 
         def dispatch(bkey, padded_flush):
             bucket, fp = bkey
             entries = pending.pop(bkey)
             self._dispatch(bucket, fp, entries, cfg.batch, elog,
-                           t_start, padded_flush)
+                           padded_flush)
             for e in entries:
                 mark_done(e)
 
@@ -410,6 +549,7 @@ class CalibrationService:
                         continue
                     alive = True
                     req = queues[t].popleft()
+                    t_pop = time.time()
                     reg.gauge_set("serve_queue_depth", len(queues[t]),
                                   tenant=t)
                     skey = (t, os.path.abspath(req.dataset),
@@ -422,6 +562,9 @@ class CalibrationService:
                             f"expected {req.t0}")
                     entry, fp = self._load_entry(
                         req, data, streams[skey]["meta"])
+                    entry.enqueued_at = enqueued_at.get(
+                        req.request_id, t_start)
+                    entry.started_at = t_pop
                     bkey = (bucket_of(data, entry.cdata, entry.p0), fp)
                     pending[bkey].append(entry)
                     if len(pending[bkey]) >= cfg.batch:
@@ -439,6 +582,20 @@ class CalibrationService:
             for mgr in ckmgrs.values():
                 mgr.flush()
                 mgr.close()
+            if reg.enabled:
+                # one cumulative snapshot per worker: the aggregation
+                # side (obs/aggregate.py) merges the fleet's snapshots
+                # and keeps the newest per worker id
+                from sagecal_tpu.obs.aggregate import (
+                    metrics_snapshot_path, write_metrics_snapshot,
+                )
+
+                try:
+                    write_metrics_snapshot(
+                        metrics_snapshot_path(cfg.out_dir),
+                        registry=reg)
+                except OSError:
+                    pass
 
         wall = time.time() - t_start
         lat = sorted(self._latencies)
@@ -452,6 +609,8 @@ class CalibrationService:
             "p50_latency_s": p50,
             "results": self._results,
         }
+        if self._slo is not None and self._slo.enabled:
+            summary["slo"] = self._slo.evaluate(registry=reg)
         if elog is not None:
             elog.emit("run_done", app="serve",
                       **{k: v for k, v in summary.items()

@@ -1,0 +1,730 @@
+"""Serve-plane observability (obs/aggregate.py, obs/slo.py, diag serve):
+
+- mergeable histograms: shard-merge == single-stream, quantile bounds
+  contain the exact percentile of a known distribution;
+- registry state export/restore: counters stay monotonic across a
+  simulated preemption+resume, gauges first-wins, snapshot-file dedupe
+  keeps one generation per worker;
+- SLO burn-rate monitor: multi-window alert fires and clears on edges,
+  shed_recommended tracks the fast-burn threshold, post-hoc evaluation
+  from result manifests;
+- bench history: append stamps schema/rev/fingerprint, trend verdicts
+  follow the gate direction tables;
+- lifecycle span checking: complete chains, cache_hit XOR compile;
+- ``diag serve``: fleet report over fabricated artifacts, exit 1 on a
+  burning tenant, exit 0 healthy;
+- (slow) real two-worker synthetic serve: cross-process aggregation
+  matches the single-process oracle within bucket bounds, lifecycle
+  traces survive the manifest boundary, cache-hit path skips compile,
+  telemetry off is bit-identical on solutions.
+"""
+
+import json
+import os
+
+import pytest
+
+pytestmark = pytest.mark.serve_obs
+
+
+# ---------------------------------------------------------------------------
+# histograms
+
+class TestHistogramMerge:
+    def _hist(self, values, buckets=(0.1, 1.0, 10.0)):
+        from sagecal_tpu.obs.registry import _Histogram
+
+        h = _Histogram(buckets)
+        for v in values:
+            h.observe(v)
+        return h
+
+    def test_shard_merge_matches_single_stream(self):
+        from sagecal_tpu.obs.registry import _Histogram
+
+        values = [0.01 * i for i in range(1, 301)]
+        single = self._hist(values)
+        shards = [self._hist(values[i::3]) for i in range(3)]
+        merged = _Histogram.from_snapshot(shards[0].snapshot())
+        for s in shards[1:]:
+            merged.merge(_Histogram.from_snapshot(s.snapshot()))
+        assert merged.snapshot() == single.snapshot()
+
+    def test_merge_is_associative(self):
+        from sagecal_tpu.obs.registry import _Histogram
+
+        # power-of-two values: float addition is exact, so snapshot
+        # equality holds regardless of merge order
+        a, b, c = (self._hist([0.25 * 2 ** i]) for i in range(3))
+        ab = _Histogram.from_snapshot(a.snapshot())
+        ab.merge(b)
+        ab.merge(c)
+        bc = _Histogram.from_snapshot(b.snapshot())
+        bc.merge(c)
+        a2 = _Histogram.from_snapshot(a.snapshot())
+        a2.merge(bc)
+        assert ab.snapshot() == a2.snapshot()
+
+    def test_merge_rejects_mismatched_buckets(self):
+        h1 = self._hist([0.5], buckets=(0.1, 1.0))
+        h2 = self._hist([0.5], buckets=(0.1, 2.0))
+        with pytest.raises(ValueError):
+            h1.merge(h2)
+
+    def test_quantile_bounds_contain_exact_percentile(self):
+        import math
+
+        # 200 known latencies spread over 4 decades
+        values = sorted(0.002 * 1.05 ** i for i in range(200))
+        h = self._hist(values, buckets=(0.001, 0.01, 0.1, 1.0, 10.0, 100.0))
+        for q in (0.5, 0.9, 0.95, 0.99):
+            rank = min(len(values), max(1, math.ceil(q * len(values))))
+            exact = values[rank - 1]
+            lo, hi = h.quantile_bounds(q)
+            assert lo <= exact <= hi, (q, exact, lo, hi)
+        # bounds tightened by observed extremes, not raw bucket edges
+        lo, _ = h.quantile_bounds(0.0001)
+        _, hi = h.quantile_bounds(0.9999)
+        assert lo >= values[0] and hi <= values[-1]
+
+    def test_empty_histogram_has_no_bounds(self):
+        h = self._hist([])
+        assert h.quantile_bounds(0.5) is None
+
+
+# ---------------------------------------------------------------------------
+# registry state + snapshot files
+
+class TestRegistryState:
+    def _reg(self):
+        from sagecal_tpu.obs.registry import MetricsRegistry
+
+        r = MetricsRegistry()
+        r.counter_inc("serve_requests_total", 3, tenant="t0")
+        r.counter_inc("serve_requests_total", 2, tenant="t1")
+        r.gauge_set("queue_depth", 4.0)
+        r.observe("serve_request_latency_seconds", 0.3, tenant="t0")
+        r.observe("serve_request_latency_seconds", 2.0, tenant="t0")
+        return r
+
+    def test_export_restore_roundtrip(self):
+        from sagecal_tpu.obs.registry import MetricsRegistry
+
+        r = self._reg()
+        r2 = MetricsRegistry()
+        r2.restore_state(r.export_state())
+        assert r2.export_state() == r.export_state()
+
+    def test_restore_is_additive_for_counters(self):
+        """--resume restores checkpointed counters, then the run keeps
+        counting on top: totals stay monotonic across preemptions."""
+        from sagecal_tpu.obs.registry import MetricsRegistry
+
+        r = MetricsRegistry()
+        r.restore_state(self._reg().export_state())
+        r.counter_inc("serve_requests_total", 1, tenant="t0")
+        assert r.get_counter("serve_requests_total", tenant="t0") == 4
+        # a second restore ADDS again (callers dedupe generations)
+        r.restore_state(self._reg().export_state())
+        assert r.get_counter("serve_requests_total", tenant="t0") == 7
+
+    def test_restore_keeps_live_gauges(self):
+        from sagecal_tpu.obs.registry import MetricsRegistry
+
+        r = MetricsRegistry()
+        r.gauge_set("queue_depth", 9.0)
+        r.restore_state(self._reg().export_state())
+        assert r.get_gauge("queue_depth") == 9.0
+
+    def test_merge_states_equals_combined(self):
+        from sagecal_tpu.obs.aggregate import (
+            merge_states,
+            state_counter_total,
+            state_histogram,
+        )
+
+        s1, s2 = self._reg().export_state(), self._reg().export_state()
+        merged = merge_states([s1, s2])
+        assert state_counter_total(merged, "serve_requests_total") == 10
+        assert state_counter_total(
+            merged, "serve_requests_total", tenant="t1") == 4
+        h = state_histogram(merged, "serve_request_latency_seconds")
+        assert h.count == 4 and h.vmax == 2.0
+
+
+class TestSnapshotFiles:
+    def test_write_read_dedupe(self, tmp_path, monkeypatch):
+        from sagecal_tpu.obs.aggregate import (
+            dedupe_snapshots,
+            metrics_snapshot_path,
+            read_metrics_snapshots,
+            write_metrics_snapshot,
+        )
+        from sagecal_tpu.obs.registry import MetricsRegistry
+
+        out = str(tmp_path)
+        monkeypatch.setenv("SAGECAL_WORKER_ID", "w0")
+        r = MetricsRegistry()
+        r.counter_inc("serve_requests_total", 2)
+        write_metrics_snapshot(metrics_snapshot_path(out), registry=r)
+        # the same worker snapshots again after a resume: newer file
+        # REPLACES (same path), a second worker adds one
+        r.counter_inc("serve_requests_total", 3)
+        write_metrics_snapshot(metrics_snapshot_path(out), registry=r)
+        monkeypatch.setenv("SAGECAL_WORKER_ID", "w1")
+        r2 = MetricsRegistry()
+        r2.counter_inc("serve_requests_total", 1)
+        write_metrics_snapshot(metrics_snapshot_path(out), registry=r2)
+        docs = dedupe_snapshots(read_metrics_snapshots(out))
+        assert {d["worker_id"] for d in docs} == {"w0", "w1"}
+        from sagecal_tpu.obs.aggregate import (
+            merge_states,
+            state_counter_total,
+        )
+
+        merged = merge_states(d["state"] for d in docs)
+        assert state_counter_total(merged, "serve_requests_total") == 6
+
+    def test_corrupt_snapshot_skipped(self, tmp_path):
+        from sagecal_tpu.obs.aggregate import read_metrics_snapshots
+
+        p = tmp_path / "metrics-x.json"
+        p.write_text("{not json")
+        assert read_metrics_snapshots(str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# SLOs
+
+class _FakeLog:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **fields):
+        self.events.append(dict(kind=kind, **fields))
+
+
+class TestSLO:
+    def _spec(self, **kw):
+        from sagecal_tpu.obs.slo import SLOSpec
+
+        kw.setdefault("tenant", "t0")
+        kw.setdefault("deadline_s", 1.0)
+        return SLOSpec(**kw)
+
+    def test_spec_validation(self):
+        from sagecal_tpu.obs.slo import SLOSpec
+
+        with pytest.raises(ValueError):
+            SLOSpec(tenant="t", deadline_s=0.0)
+        with pytest.raises(ValueError):
+            SLOSpec(tenant="t", deadline_s=1.0, availability=1.0)
+        # windows normalize to ascending (short, long)
+        s = SLOSpec(tenant="t", deadline_s=1.0,
+                    windows_s=(600.0, 300.0))
+        assert s.windows_s == (300.0, 600.0)
+        assert self._spec(availability=0.99).error_budget == \
+            pytest.approx(0.01)
+
+    def test_load_specs_slo_json_and_manifest(self, tmp_path):
+        from sagecal_tpu.obs.slo import load_slo_specs
+
+        slo = tmp_path / "slo.json"
+        slo.write_text(json.dumps({"slos": [
+            {"tenant": "t0", "deadline_s": 2.0, "availability": 0.95},
+        ]}))
+        specs = load_slo_specs(str(slo))
+        assert specs["t0"].deadline_s == 2.0
+        # SLOs riding inside a request manifest
+        man = tmp_path / "requests.json"
+        man.write_text(json.dumps({
+            "requests": [], "slos": [{"tenant": "t1", "deadline_s": 5.0}],
+        }))
+        assert list(load_slo_specs(str(man))) == ["t1"]
+        # a manifest without SLOs -> disabled, not an error
+        man2 = tmp_path / "plain.json"
+        man2.write_text(json.dumps({"requests": []}))
+        assert load_slo_specs(str(man2)) == {}
+
+    def test_burn_alert_fires_and_clears_on_edges(self):
+        from sagecal_tpu.obs.registry import MetricsRegistry
+        from sagecal_tpu.obs.slo import SLOMonitor
+
+        spec = self._spec(availability=0.9, windows_s=(10.0, 60.0))
+        mon = SLOMonitor({"t0": spec})
+        elog, reg = _FakeLog(), MetricsRegistry()
+        t0 = 1000.0
+        for i in range(10):  # every request blows the deadline
+            mon.observe("t0", t0 + i, 5.0, "ok")
+        st, = mon.evaluate(now=t0 + 10, elog=elog, registry=reg)
+        assert st["burning"] and st["transition"] == "firing"
+        # steady burn -> no duplicate event
+        mon.evaluate(now=t0 + 11, elog=elog, registry=reg)
+        assert [e["kind"] for e in elog.events] == ["slo_burn_alert"]
+        assert elog.events[0]["state"] == "firing"
+        assert reg.get_gauge("serve_slo_burn_rate", tenant="t0",
+                             window="10s") >= spec.alert_burn
+        # recovery: healthy traffic, bad samples age out of BOTH windows
+        for i in range(20):
+            mon.observe("t0", t0 + 100 + i, 0.1, "ok")
+        st, = mon.evaluate(now=t0 + 100 + 60.0, elog=elog, registry=reg)
+        assert not st["burning"] and st["transition"] == "cleared"
+        assert [e["state"] for e in elog.events] == ["firing", "cleared"]
+
+    def test_short_window_blip_does_not_fire(self):
+        """Multi-window alerting: a fresh spike burns the short window
+        but not yet the long one -> quiet."""
+        from sagecal_tpu.obs.slo import SLOMonitor
+
+        mon = SLOMonitor(
+            {"t0": self._spec(availability=0.9, windows_s=(10.0, 1000.0))})
+        t0 = 1000.0
+        for i in range(200):  # long healthy history
+            mon.observe("t0", t0 + i, 0.1, "ok")
+        for i in range(5):  # brief spike at the end
+            mon.observe("t0", t0 + 200 + i, 5.0, "diverged")
+        st, = mon.evaluate(now=t0 + 205)
+        assert not st["burning"]
+        assert st["burn_rates"][0] > st["burn_rates"][1]
+
+    def test_shed_recommended_on_fast_burn(self):
+        from sagecal_tpu.obs.slo import SLOMonitor
+
+        spec = self._spec(availability=0.9, windows_s=(10.0, 60.0))
+        mon = SLOMonitor({"t0": spec})
+        for i in range(10):
+            mon.observe("t0", 1000.0 + i, 9.0, "diverged")
+        st, = mon.evaluate(now=1010.0)
+        assert st["shed_recommended"]  # burn 10 == shed threshold
+        assert mon.shed_recommended("unknown-tenant") is False
+
+    def test_evaluate_results_posthoc(self):
+        from sagecal_tpu.obs.slo import evaluate_results
+
+        specs = {"slow": self._spec(tenant="slow", deadline_s=0.01,
+                                    availability=0.9),
+                 "fast": self._spec(tenant="fast", deadline_s=60.0,
+                                    availability=0.9)}
+        results = []
+        for i in range(6):
+            for t in ("slow", "fast"):
+                results.append({"tenant": t, "completed_at": 100.0 + i,
+                                "latency_s": 1.0, "verdict": "ok"})
+        evals = {e["tenant"]: e for e in evaluate_results(specs, results)}
+        assert evals["slow"]["burning"]
+        assert not evals["fast"]["burning"]
+        assert evals["fast"]["budget_remaining"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# bench history
+
+class TestBenchHistory:
+    def test_append_stamps_and_reads(self, tmp_path):
+        from sagecal_tpu.obs.perf import (
+            BENCH_HISTORY_SCHEMA_VERSION,
+            append_bench_history,
+            read_bench_history,
+        )
+
+        p = str(tmp_path / "hist.jsonl")
+        append_bench_history({"mode": "tpu", "value": 10.0}, path=p)
+        append_bench_history({"mode": "tpu", "value": 12.0}, path=p)
+        with open(p, "a") as f:
+            f.write("corrupt line\n")
+        rows = read_bench_history(p)
+        assert len(rows) == 2
+        for r in rows:
+            assert r["history_schema_version"] == \
+                BENCH_HISTORY_SCHEMA_VERSION
+            assert r["config_fingerprint"] == \
+                rows[0]["config_fingerprint"]
+            assert "ts" in r and "git_rev" in r
+
+    def test_trend_directions(self, tmp_path):
+        from sagecal_tpu.obs.perf import (
+            append_bench_history,
+            bench_trend,
+            format_bench_trend,
+        )
+
+        p = str(tmp_path / "hist.jsonl")
+        # higher-better "value" rises, lower-better latency rises too
+        append_bench_history({"mode": "tpu", "value": 10.0,
+                              "serve_p50_latency_s": 1.0}, path=p)
+        append_bench_history({"mode": "tpu", "value": 12.0,
+                              "serve_p50_latency_s": 2.0}, path=p)
+        # a different config must not pollute the window
+        append_bench_history({"mode": "other", "value": 1.0}, path=p)
+        from sagecal_tpu.obs.perf import read_bench_history
+
+        hist = read_bench_history(p)
+        trend = bench_trend(hist[:2], last_k=5)
+        verdicts = {t["metric"]: t["verdict"] for t in trend}
+        assert verdicts["value"] == "better"
+        assert verdicts["serve_p50_latency_s"] == "worse"
+        assert all(t["runs"] == 2 for t in trend)
+        assert "value" in format_bench_trend(trend)
+        # newest row alone (no same-fingerprint partner) -> no trend
+        assert bench_trend(hist, last_k=5) == []
+
+
+# ---------------------------------------------------------------------------
+# lifecycle span checking (fabricated spans: no solver needed)
+
+def _mk_trace(trace_id, cached=False, drop=(), extra=()):
+    root = f"{trace_id}-root"
+    spans = [{"kind": "span", "trace_id": trace_id, "span_id": root,
+              "parent_id": "", "name": "serve.request",
+              "ts": 0.0, "dur": 1.0}]
+    names = ["enqueue", "schedule", "pack",
+             "cache_hit" if cached else "compile",
+             "execute", "unpack", "write_manifest"]
+    names += list(extra)
+    for i, n in enumerate(names):
+        if n in drop:
+            continue
+        spans.append({"kind": "span", "trace_id": trace_id,
+                      "span_id": f"{trace_id}-{i}", "parent_id": root,
+                      "name": n, "ts": 0.1 * i, "dur": 0.05})
+    return spans
+
+
+class TestLifecycleCheck:
+    def test_complete_compile_and_cache_hit_paths(self):
+        from sagecal_tpu.obs.aggregate import check_lifecycle
+
+        for cached in (False, True):
+            res = check_lifecycle(_mk_trace("t1", cached=cached))
+            assert res["complete"], res["problems"]
+            assert ("cache_hit" in res["phases"]) == cached
+
+    def test_missing_phase_detected(self):
+        from sagecal_tpu.obs.aggregate import check_lifecycle
+
+        res = check_lifecycle(_mk_trace("t1", drop=("unpack",)))
+        assert not res["complete"]
+        assert any("unpack" in p for p in res["problems"])
+
+    def test_compile_and_cache_hit_both_present_is_a_problem(self):
+        from sagecal_tpu.obs.aggregate import check_lifecycle
+
+        res = check_lifecycle(_mk_trace("t1", extra=("cache_hit",)))
+        assert not res["complete"]
+
+    def test_lifecycle_report_matches_manifests(self):
+        from sagecal_tpu.obs.aggregate import lifecycle_report
+
+        spans = _mk_trace("tA") + _mk_trace("tB", cached=True)
+        results = [{"request_id": "rA", "trace_id": "tA"},
+                   {"request_id": "rB", "trace_id": "tB"},
+                   {"request_id": "rC", "trace_id": "tMISSING"}]
+        rep = lifecycle_report(spans, results)
+        assert rep["traces"] == rep["complete"] == 2
+        assert rep["cache_hit_traces"] == 1
+        assert rep["manifests_with_trace"] == 3
+        assert rep["manifests_matched"] == 2
+        assert not rep["ok"]  # rC has no trace
+
+
+# ---------------------------------------------------------------------------
+# diag serve over fabricated artifacts
+
+class TestDiagServe:
+    def _fabricate(self, tmp_path, slow_tenant=True):
+        from sagecal_tpu.obs.aggregate import (
+            metrics_snapshot_path,
+            write_metrics_snapshot,
+        )
+        from sagecal_tpu.obs.registry import MetricsRegistry
+
+        out = tmp_path / "out"
+        out.mkdir()
+        reg = MetricsRegistry()
+        spans = []
+        t0 = 1000.0
+        for i in range(8):
+            tenant = f"tenant{i % 2}"
+            lat = 5.0 if (tenant == "tenant1" and slow_tenant) else 0.2
+            tid = f"trace-{i}"
+            doc = {
+                "request_id": f"req{i:03d}", "tenant": tenant,
+                "bucket": "N7xT4", "verdict": "ok",
+                "enqueued_at": t0 + i, "started_at": t0 + i + 0.1,
+                "completed_at": t0 + i + 0.1 + lat,
+                "queue_wait_s": 0.1, "latency_s": lat,
+                "trace_id": tid, "span_id": f"{tid}-root",
+            }
+            (out / f"req{i:03d}.result.json").write_text(json.dumps(doc))
+            reg.counter_inc("serve_requests_total", tenant=tenant)
+            reg.observe("serve_request_latency_seconds", lat,
+                        tenant=tenant)
+            spans.extend(_mk_trace(tid, cached=i >= 2))
+        reg.counter_inc("serve_executable_cache_hits_total", 6)
+        reg.counter_inc("serve_executable_cache_misses_total", 2)
+        os.environ.setdefault("SAGECAL_WORKER_ID", "fab")
+        write_metrics_snapshot(metrics_snapshot_path(str(out)),
+                               registry=reg)
+        sp = tmp_path / "spans.jsonl"
+        with open(sp, "w") as f:
+            for s in spans:
+                f.write(json.dumps(s) + "\n")
+        slo = tmp_path / "slo.json"
+        slo.write_text(json.dumps({"slos": [
+            {"tenant": "tenant0", "deadline_s": 1.0},
+            {"tenant": "tenant1", "deadline_s": 1.0},
+        ]}))
+        return out, sp, slo
+
+    def test_burning_tenant_exits_nonzero(self, tmp_path, capsys):
+        from sagecal_tpu.obs.diag import main as diag_main
+
+        out, sp, slo = self._fabricate(tmp_path, slow_tenant=True)
+        report = tmp_path / "report.json"
+        rc = diag_main(["serve", str(out), "--spans", str(sp),
+                        "--slo", str(slo), "--report", str(report)])
+        assert rc == 1
+        text = capsys.readouterr().out
+        assert "SLO BURNING" in capsys.readouterr().err or \
+            "BURNING" in text
+        assert "SERVE: UNHEALTHY" in text
+        doc = json.loads(report.read_text())
+        assert doc["exit"] == 1 and doc["requests"] == 8
+        assert doc["cache"] == {"hits": 6.0, "misses": 2.0}
+
+    def test_healthy_fleet_exits_zero(self, tmp_path, capsys):
+        from sagecal_tpu.obs.diag import main as diag_main
+
+        out, sp, slo = self._fabricate(tmp_path, slow_tenant=False)
+        rc = diag_main(["serve", str(out), "--spans", str(sp),
+                        "--slo", str(slo)])
+        text = capsys.readouterr().out
+        assert rc == 0, text
+        assert "SERVE: OK" in text
+        assert "8 requests" in text
+        assert "hit ratio" in text
+        # merged-histogram bounds rendered per tenant
+        assert "p50=[" in text
+        # the span file fed the lifecycle audit: all 8 traces complete
+        assert "8/8 complete" in text
+
+    def test_empty_out_dir_exits_nonzero(self, tmp_path):
+        from sagecal_tpu.obs.diag import main as diag_main
+
+        empty = tmp_path / "nothing"
+        empty.mkdir()
+        assert diag_main(["serve", str(empty)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# real serve runs (slow): cross-process aggregation + bit-identity
+
+def _run_worker(out_dir, reqs, wid, span_path, monkeypatch, batch=2,
+                **cfg_kw):
+    """One simulated worker process: fresh registry, own worker id,
+    shared span file; returns the service summary."""
+    import sagecal_tpu.obs.registry as regmod
+    from sagecal_tpu.apps.config import ServeConfig
+    from sagecal_tpu.obs.trace import close_tracer, configure_tracer
+    from sagecal_tpu.serve.service import CalibrationService
+
+    monkeypatch.setenv("SAGECAL_WORKER_ID", wid)
+    monkeypatch.setattr(regmod, "_GLOBAL", regmod.MetricsRegistry())
+    configure_tracer(run_id=f"run-{wid}", path=str(span_path))
+    try:
+        cfg = ServeConfig(out_dir=str(out_dir), batch=batch, **cfg_kw)
+        return CalibrationService(cfg, log=lambda *a: None).run(reqs)
+    finally:
+        close_tracer()
+
+
+@pytest.mark.slow
+class TestServeObsEndToEnd:
+    def test_two_worker_fleet_view(self, tmp_path, monkeypatch):
+        """Two workers split one workload into a shared out-dir; the
+        aggregated view must match the single-process oracle within
+        histogram bucket bounds, with complete lifecycle traces across
+        the manifest boundary and a cache-hit trace that skips compile."""
+        import math
+
+        from sagecal_tpu.obs.aggregate import (
+            fleet_view,
+            lifecycle_report,
+            quantile_bounds_from_state,
+            state_counter_total,
+        )
+        from sagecal_tpu.obs.registry import set_telemetry
+        from sagecal_tpu.obs.trace import set_trace
+        from sagecal_tpu.serve.request import load_requests
+        from sagecal_tpu.serve.synthetic import make_synthetic_workload
+
+        set_telemetry(True)
+        set_trace(True)
+        try:
+            manifest = make_synthetic_workload(
+                str(tmp_path / "w"), 6, n_tenants=2)
+            reqs = load_requests(manifest)
+            out = tmp_path / "out"
+            spans = tmp_path / "spans.jsonl"
+            # worker 0 serves tenant0 (4 reqs, one shape, batch 2 ->
+            # second batch is a cache hit), worker 1 serves tenant1
+            w0 = [r for r in reqs if r.tenant == "tenant0"]
+            w1 = [r for r in reqs if r.tenant == "tenant1"]
+            assert w0 and w1
+            s0 = _run_worker(out, w0, "w0", spans, monkeypatch)
+            s1 = _run_worker(out, w1, "w1", spans, monkeypatch)
+            assert s0["served"] == len(w0) and s1["served"] == len(w1)
+
+            view = fleet_view([str(out)], span_paths=[str(spans)])
+            assert view["snapshots"] == 2
+            assert len(view["results"]) == len(reqs)
+            assert state_counter_total(
+                view["state"], "serve_requests_total") == len(reqs)
+
+            # oracle: exact percentiles over ALL manifests' latencies
+            lats = sorted(float(r["latency_s"]) for r in view["results"])
+            bounds = quantile_bounds_from_state(
+                view["state"], "serve_request_latency_seconds")
+            for q, (lo, hi) in bounds.items():
+                rank = min(len(lats), max(1, math.ceil(q * len(lats))))
+                assert lo <= lats[rank - 1] <= hi
+
+            # every manifest row carries the lifecycle timing fields
+            for r in view["results"]:
+                assert r["completed_at"] >= r["started_at"] >= \
+                    r["enqueued_at"]
+                assert r["queue_wait_s"] >= 0
+                assert r["trace_id"] and r["span_id"]
+
+            rep = lifecycle_report(view["spans"], view["results"])
+            assert rep["ok"], rep["manifest_problems"]
+            assert rep["complete"] == len(reqs)
+            # w0's second same-bucket batch hit the executable cache
+            assert rep["cache_hit_traces"] >= 1
+            assert rep["compile_traces"] >= 1
+
+            # diag serve agrees: healthy fleet, exit 0
+            from sagecal_tpu.obs.diag import main as diag_main
+
+            assert diag_main(["serve", str(out),
+                              "--spans", str(spans)]) == 0
+        finally:
+            set_telemetry(None)
+            set_trace(None)
+
+    def test_slow_tenant_trips_burn_alert_live(self, tmp_path,
+                                               monkeypatch):
+        """An injected slow tenant (impossible deadline) must fire
+        ``slo_burn_alert`` DURING the run and flip ``diag serve`` to a
+        nonzero exit afterwards."""
+        from sagecal_tpu.obs.registry import set_telemetry
+        from sagecal_tpu.serve.request import load_requests
+        from sagecal_tpu.serve.synthetic import make_synthetic_workload
+
+        set_telemetry(True)
+        try:
+            manifest = make_synthetic_workload(
+                str(tmp_path / "w"), 2, n_tenants=1, shapes=((7, 4, 2),))
+            reqs = load_requests(manifest)
+            slo = tmp_path / "slo.json"
+            slo.write_text(json.dumps({"slos": [
+                {"tenant": "tenant0", "deadline_s": 1e-4},
+            ]}))
+            out = tmp_path / "out"
+            elog = _FakeLog()
+            import sagecal_tpu.obs.registry as regmod
+            from sagecal_tpu.apps.config import ServeConfig
+            from sagecal_tpu.serve.service import CalibrationService
+
+            monkeypatch.setenv("SAGECAL_WORKER_ID", "w0")
+            monkeypatch.setattr(regmod, "_GLOBAL",
+                                regmod.MetricsRegistry())
+            cfg = ServeConfig(out_dir=str(out), batch=2, slo=str(slo))
+            summary = CalibrationService(cfg, log=lambda *a: None).run(
+                reqs, elog=elog)
+            alerts = [e for e in elog.events
+                      if e["kind"] == "slo_burn_alert"]
+            assert alerts and alerts[0]["state"] == "firing"
+            assert alerts[0]["tenant"] == "tenant0"
+            assert summary["slo"][0]["burning"]
+            assert regmod._GLOBAL.get_gauge(
+                "serve_slo_shed_recommended", tenant="tenant0") == 1.0
+
+            from sagecal_tpu.obs.diag import main as diag_main
+
+            assert diag_main(["serve", str(out),
+                              "--slo", str(slo)]) == 1
+        finally:
+            set_telemetry(None)
+
+    def test_counters_monotonic_across_resume(self, tmp_path,
+                                              monkeypatch):
+        """Preempt after a full run, resume: the restored registry keeps
+        the pre-preemption request count (S2)."""
+        import sagecal_tpu.obs.registry as regmod
+        from sagecal_tpu.obs.registry import set_telemetry
+        from sagecal_tpu.serve.request import load_requests
+        from sagecal_tpu.serve.synthetic import make_synthetic_workload
+
+        set_telemetry(True)
+        try:
+            manifest = make_synthetic_workload(
+                str(tmp_path / "w"), 2, n_tenants=1, shapes=((7, 4, 2),))
+            reqs = load_requests(manifest)
+            out = tmp_path / "out"
+            _run_worker(out, reqs, "w0", tmp_path / "s.jsonl",
+                        monkeypatch, checkpoint_every=1)
+            _run_worker(out, reqs, "w0", tmp_path / "s.jsonl",
+                        monkeypatch, checkpoint_every=1, resume=True)
+            # the resumed process served 0 new requests but restored the
+            # checkpointed counters: the fleet still shows 2 served
+            from sagecal_tpu.obs.aggregate import state_counter_total
+
+            assert state_counter_total(
+                regmod._GLOBAL.export_state(), "serve_requests_total",
+                tenant="tenant0") == 2
+        finally:
+            set_telemetry(None)
+
+    def test_telemetry_off_is_bit_identical(self, tmp_path, monkeypatch):
+        """The whole observability layer must be free when off: the
+        solutions bytes of a telemetry+trace run equal a dark run."""
+        from sagecal_tpu.obs.registry import set_telemetry
+        from sagecal_tpu.obs.trace import set_trace
+        from sagecal_tpu.serve.request import load_requests
+        from sagecal_tpu.serve.synthetic import make_synthetic_workload
+
+        manifest = make_synthetic_workload(
+            str(tmp_path / "w"), 2, n_tenants=1, shapes=((7, 4, 2),))
+        reqs = load_requests(manifest)
+
+        def solutions_bytes(sub):
+            out = {}
+            for n in sorted(os.listdir(tmp_path / sub)):
+                if n.endswith(".result.json"):
+                    doc = json.loads((tmp_path / sub / n).read_text())
+                    with open(doc["solutions"], "rb") as f:
+                        out[doc["request_id"]] = f.read()
+            return out
+
+        set_telemetry(True)
+        set_trace(True)
+        try:
+            _run_worker(tmp_path / "on", reqs, "w0",
+                        tmp_path / "s.jsonl", monkeypatch)
+        finally:
+            set_telemetry(False)
+            set_trace(False)
+        try:
+            _run_worker(tmp_path / "off", reqs, "w1",
+                        tmp_path / "s2.jsonl", monkeypatch)
+        finally:
+            set_telemetry(None)
+            set_trace(None)
+        on, off = solutions_bytes("on"), solutions_bytes("off")
+        assert set(on) == set(off) and on
+        for rid in on:
+            assert on[rid] == off[rid], f"{rid} solutions differ"
+        # and the dark out-dir carries no telemetry artifacts
+        assert not [n for n in os.listdir(tmp_path / "off")
+                    if n.startswith("metrics-")]

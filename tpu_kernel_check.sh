@@ -99,9 +99,9 @@ if probe; then
 fi
 echo "=== bf16-coherency fused bench"
 if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
-echo "=== telemetry+quality+trace test pass (CPU, marker-driven)"
+echo "=== telemetry+quality+trace+serve_obs test pass (CPU, marker-driven)"
 JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 900 \
-  python -m pytest tests/ -q -m "telemetry or quality or trace" \
+  python -m pytest tests/ -q -m "telemetry or quality or trace or serve_obs" \
   -p no:cacheprovider | tail -3
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then echo "telemetry test pass FAILED rc=$rc"; exit 1; fi
@@ -201,9 +201,11 @@ rs, ra = straggler_stats(sync)['ratio'], straggler_stats(asy)['ratio']
 assert ra < rs, (rs, ra)
 print('async smoke ok:', v, 'straggler ratio %.2f -> %.2f' % (rs, ra))" \
   || { echo "async-consensus smoke validate FAILED"; exit 1; }
-echo "=== multi-tenant serve smoke (CPU, synthetic mixed shapes)"
+echo "=== multi-tenant serve smoke (CPU, synthetic mixed shapes + obs)"
 SRVDIR=$(mktemp -d)
-JAX_PLATFORMS=cpu timeout 420 python -m sagecal_tpu.apps.cli serve \
+JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 SAGECAL_TRACE=1 \
+  SAGECAL_TRACE_LOG="$SRVDIR/spans.jsonl" SAGECAL_WORKER_ID=smoke \
+  timeout 420 python -m sagecal_tpu.apps.cli serve \
   --synthetic 6 --tenants 2 --batch 2 --out-dir "$SRVDIR" \
   || { echo "serve smoke FAILED rc=$?"; exit 1; }
 JAX_PLATFORMS=cpu timeout 60 python - "$SRVDIR" <<'PY'
@@ -216,12 +218,27 @@ for f in res:
     r = json.load(open(f))
     assert r.get("verdict"), (f, r)
     assert os.path.exists(r["solutions"]), (f, r["solutions"])
+    assert r["completed_at"] >= r["started_at"] >= r["enqueued_at"], r
+    assert r.get("trace_id") and r.get("span_id"), (f, r)
     buckets.add(r["bucket"])
 # --synthetic alternates two shape classes -> two compiled buckets
 assert len(buckets) == 2, buckets
-print("serve smoke ok:", len(res), "requests,", sorted(buckets))
+# every manifest's trace must be a COMPLETE lifecycle span chain
+# (enqueue..write_manifest, exactly one of compile|cache_hit)
+from sagecal_tpu.obs.aggregate import lifecycle_report
+from sagecal_tpu.obs.trace import read_spans
+spans = read_spans(os.path.join(out, "spans.jsonl"))
+rep = lifecycle_report(spans, [json.load(open(f)) for f in res])
+assert rep["ok"], rep["manifest_problems"]
+assert rep["manifests_matched"] == 6, rep
+print("serve smoke ok:", len(res), "requests,", sorted(buckets),
+      "- %d/%d lifecycle traces complete" % (rep["complete"], rep["traces"]))
 PY
 [ $? = 0 ] || { echo "serve smoke validate FAILED"; exit 1; }
+# fleet report over the smoke run's artifacts: healthy -> exit 0
+JAX_PLATFORMS=cpu timeout 120 python -m sagecal_tpu.obs.diag serve \
+  "$SRVDIR" --spans "$SRVDIR/spans.jsonl" \
+  || { echo "diag serve FAILED on a healthy run"; exit 1; }
 rm -rf "$SRVDIR"
 echo "=== refine smoke (CPU, bilevel flux recovery)"
 # sky-model refinement end to end: 3 outer LBFGS steps over a
