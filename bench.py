@@ -760,6 +760,143 @@ def run_refine_bench(outer_iters=3, nstations=5, tilesz=2):
     }
 
 
+def run_stream_bench(nstations=24, ntime=8, nchan=2, windows=5):
+    """Streaming-calibration row: latency-to-first-solution of the
+    warm-start chain vs the cold baseline on one synthetic stream.
+
+    Each sliding window is one request whose answer the telescope is
+    waiting on, so the serving number is the per-window wall time once
+    the chain is warm — ``latency_to_first_solution_s`` is the warm
+    chain's steady-state latency (median over the post-compile
+    windows; lower-better, gated), and ``stream_warm_speedup`` is the
+    cold baseline's steady state over the warm one (higher-better).
+    The warm chain must win on BOTH fewer iterations (warm budgets
+    e=1/l=4 vs cold e=3/l=10, the realistic asymmetry: a window that
+    starts at the previous window's solution needs a fraction of the
+    cold budget) and the carried-solution start; a regression in either
+    the executable reuse or the chain plumbing shows up here.  Runs on
+    the CPU backend (the chain math is f64 there, matching the stream
+    smoke's acceptance environment).
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from sagecal_tpu.apps.config import StreamConfig
+    from sagecal_tpu.fleet.stream import StreamCalibrator, make_synthetic_stream
+
+    workdir = tempfile.mkdtemp(prefix="sagecal-stream-bench-")
+    try:
+        ds, sky, cluster = make_synthetic_stream(
+            workdir, nstations=nstations, ntime=ntime, nchan=nchan,
+            noise_sigma=0.0, seed=7)
+
+        def one(warm: bool):
+            cfg = StreamConfig(
+                dataset=ds, sky_model=sky, cluster_file=cluster,
+                out_dir=os.path.join(
+                    workdir, "warm" if warm else "cold"),
+                window=2, hop=1, max_windows=windows,
+                warm_start=warm, warm_emiter=1, warm_lbfgs=4,
+                max_emiter=3, max_iter=2, max_lbfgs=10,
+                solver_mode=1, use_f64=True)
+            with jax.default_device(_cpu_device()):
+                return StreamCalibrator(
+                    cfg, log=lambda *a: None).run()
+
+        cold = one(False)
+        warm = one(True)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {
+        "nstations": nstations,
+        "windows": warm["windows"],
+        "resets": warm["resets"],
+        "latency_to_first_solution_s": round(
+            warm["latency_to_first_solution_s"], 5),
+        "cold_latency_to_first_solution_s": round(
+            cold["latency_to_first_solution_s"], 5),
+        "stream_warm_speedup": round(
+            cold["latency_to_first_solution_s"]
+            / max(warm["latency_to_first_solution_s"], 1e-9), 3),
+        "first_window_latency_s": round(
+            warm["first_window_latency_s"], 3),
+    }
+
+
+def run_fleet_bench(n_requests=6, workers=2, timeout=1200.0):
+    """Fleet-serving row: end-to-end throughput of a WARM two-worker
+    fleet over a mixed-shape synthetic workload.
+
+    Two coordinator runs over the same request manifest share one AOT
+    artifact store: the first run pays every compile and populates the
+    store; the second is the steady-state fleet — every worker loads
+    its executables (zero compiles, counter-checked from the merged
+    metrics snapshots) and the measured wall covers seed + spawn +
+    claim + solve + manifest for all ``n_requests`` requests.
+    ``fleet_solves_per_sec_2workers`` (higher-better, gated) is
+    requests/wall of that warm run.  Subprocess CPU workers — the same
+    deployment the fleet smoke exercises.
+    """
+    import shutil
+    import tempfile
+    import time as _time
+
+    from sagecal_tpu.obs.aggregate import (
+        dedupe_snapshots, merge_states, read_metrics_snapshots,
+        state_counter_total,
+    )
+    from sagecal_tpu.serve.synthetic import make_synthetic_workload
+
+    workdir = tempfile.mkdtemp(prefix="sagecal-fleet-bench-")
+    try:
+        requests = make_synthetic_workload(
+            os.path.join(workdir, "data"), n_requests, n_tenants=2)
+        store = os.path.join(workdir, "aot-store")
+
+        def one(tag: str):
+            out = os.path.join(workdir, tag)
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       SAGECAL_TELEMETRY="1")
+            t0 = _time.perf_counter()
+            proc = subprocess.run(
+                [sys.executable, "-m", "sagecal_tpu.apps.fleet",
+                 "--requests", requests, "--out-dir", out,
+                 "--aot-store", store, "--workers", str(workers),
+                 "--batch", "4", "-e", "1", "-g", "2", "-l", "4",
+                 "-j", "1", "--max-idle", "6", "--f32"],
+                env=env, timeout=timeout, capture_output=True)
+            dt = _time.perf_counter() - t0
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"fleet bench ({tag}) exited "
+                    f"{proc.returncode}: {proc.stderr.decode()[-800:]}")
+            state = merge_states(
+                d["state"] for d in dedupe_snapshots(
+                    read_metrics_snapshots(out)))
+            return dt, state
+
+        dt_cold, _ = one("cold")
+        dt_warm, state = one("warm")
+        compiles = state_counter_total(
+            state, "serve_executable_cache_compiles_total")
+        aot_hits = state_counter_total(
+            state, "serve_executable_cache_aot_hits_total")
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {
+        "requests": n_requests,
+        "workers": workers,
+        "cold_wall_s": round(dt_cold, 2),
+        "warm_wall_s": round(dt_warm, 2),
+        "fleet_solves_per_sec_2workers": round(n_requests / dt_warm, 4),
+        "fleet_warm_compiles": compiles,
+        "fleet_warm_aot_hits": aot_hits,
+        "fleet_warm_speedup": round(dt_cold / dt_warm, 3),
+    }
+
+
 def _latest_flight_dump():
     """Newest flight-recorder dump matching the configured dump path, so
     the recovery event links straight to the forensics artifact."""
@@ -924,6 +1061,28 @@ def main():
             except Exception as exc:  # never sink the headline bench
                 sys.stderr.write(f"bench: refine bench failed: {exc}\n")
 
+    # streaming-calibration row: warm-chain steady-state latency-to-
+    # first-solution vs the cold baseline (CPU f64, the stream smoke's
+    # acceptance environment).  SAGECAL_BENCH_NO_STREAM=1 skips it.
+    stream_rec = None
+    if not os.environ.get("SAGECAL_BENCH_NO_STREAM"):
+        with tracer.span("bench", kind="run", variant="stream"):
+            try:
+                stream_rec = run_stream_bench()
+            except Exception as exc:  # never sink the headline bench
+                sys.stderr.write(f"bench: stream bench failed: {exc}\n")
+
+    # fleet-serving row: warm two-worker throughput over a shared AOT
+    # artifact store (subprocess CPU workers).
+    # SAGECAL_BENCH_NO_FLEET=1 skips it.
+    fleet_rec = None
+    if not os.environ.get("SAGECAL_BENCH_NO_FLEET"):
+        with tracer.span("bench", kind="run", variant="fleet"):
+            try:
+                fleet_rec = run_fleet_bench()
+            except Exception as exc:  # never sink the headline bench
+                sys.stderr.write(f"bench: fleet bench failed: {exc}\n")
+
     cpu_measured = None
     if os.environ.get("SAGECAL_BENCH_MEASURE_CPU"):
         cpu_measured = _measure_cpu_subprocess(tilesz)
@@ -1027,6 +1186,19 @@ def main():
         rec["refine_outer_iters_per_sec"] = (
             refine_rec["refine_outer_iters_per_sec"])
         rec["refine_bench"] = refine_rec
+    if stream_rec is not None:
+        # gate-able streaming row (obs/perf.py knows the directions):
+        # steady-state latency lower-better, warm speedup higher-better
+        rec["latency_to_first_solution_s"] = (
+            stream_rec["latency_to_first_solution_s"])
+        rec["stream_warm_speedup"] = stream_rec["stream_warm_speedup"]
+        rec["stream_bench"] = stream_rec
+    if fleet_rec is not None:
+        # gate-able fleet row (obs/perf.py knows the direction):
+        # warm two-worker throughput higher-better
+        rec["fleet_solves_per_sec_2workers"] = (
+            fleet_rec["fleet_solves_per_sec_2workers"])
+        rec["fleet_bench"] = fleet_rec
     if bf16_variant is not None:
         # gate-able bf16-coherency row (obs/perf.py knows directions):
         # throughput higher-better, compiled bytes accessed lower-better

@@ -290,6 +290,19 @@ def main(argv=None):
         from sagecal_tpu.apps.serve import main as serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # coordinator + N workers over a shared filesystem work queue
+        # with atomic leases and a cross-worker AOT executable store;
+        # owns its own flag surface and exit codes (apps/fleet.py)
+        from sagecal_tpu.apps.fleet import main as fleet_main
+
+        return fleet_main(argv[1:])
+    if argv and argv[0] == "stream":
+        # sliding-window streaming calibration with the elastic
+        # warm-start chain (apps/stream.py)
+        from sagecal_tpu.apps.stream import main as stream_main
+
+        return stream_main(argv[1:])
     if argv and argv[0] == "refine":
         # differentiable sky-model refinement (sagecal_tpu/refine/):
         # outer LBFGS over sky parameters around the inner gain solve;

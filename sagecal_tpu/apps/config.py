@@ -225,3 +225,115 @@ class ServeConfig:
     # per-tenant SLO specs (obs/slo.py): path to a slo.json; empty
     # falls back to any "slos" key inside the request manifest
     slo: str = ""
+    # cross-worker AOT executable artifact store directory
+    # (serve/aot_store.py); empty = in-process cache only
+    aot_store: str = ""
+    # cap on concurrently open TilePrefetcher streams (one per
+    # (tenant, dataset, tilesz, column)); 0 = unbounded (legacy).
+    # Above the cap the least-recently-used stream is closed (reader
+    # threads reaped) and transparently reopened from its remaining
+    # tiles on next touch; serve_prefetch_evictions_total counts it.
+    max_streams: int = 0
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """``sagecal-tpu fleet``: coordinator + N worker processes sharing
+    a filesystem work queue with atomic lease files (sagecal_tpu/fleet/).
+    Workers claim requests by bucket affinity, leases expire so a
+    killed worker's requests requeue, and admission control consumes
+    obs/slo.py burn rates (shed-or-degrade on overload)."""
+
+    requests: str = ""          # request manifest (JSON) path
+    out_dir: str = "fleet-out"  # solutions + result manifests
+    queue_dir: str = ""         # shared queue; default <out_dir>/queue
+    aot_store: str = ""         # shared AOT artifacts;
+    #                             default <out_dir>/aot-store
+    workers: int = 2            # worker processes the coordinator spawns
+    role: str = "coordinator"   # "coordinator" | "worker"
+    worker_id: str = ""         # set by the coordinator for workers
+    batch: int = 4              # lanes per bucketed batch solve
+    # lease protocol: claims expire after ttl; holders renew at
+    # renew_s (0 = ttl/3); an expired lease may be stolen by any worker
+    lease_ttl_s: float = 30.0
+    lease_renew_s: float = 0.0
+    poll_s: float = 0.2         # queue poll period when idle
+    max_idle_s: float = 10.0    # worker exits after this long idle
+    # placement: requests with nstations >= large_stations (and >1
+    # local device) solve via solvers/sharded.sharded_joint_fit instead
+    # of riding a vmapped batch lane; 0 disables the large path
+    large_stations: int = 0
+    # admission control on SLO burn (obs/slo.py): what to do when a
+    # tenant's shed_burn threshold trips — "shed" refuses the request
+    # (manifest verdict "shed", no solve), "degrade" solves with
+    # reduced iteration budgets (quality watchdog still verdicts the
+    # result), "off" restores PR 11 report-only behavior
+    overload_policy: str = "degrade"
+    degrade_emiter: int = 1
+    degrade_lbfgs: int = 4
+    # solver defaults (ServeConfig semantics; per-request overrides win)
+    max_emiter: int = 3
+    max_iter: int = 2
+    max_lbfgs: int = 10
+    lbfgs_m: int = 7
+    solver_mode: int = SM_OSLM_OSRLM_RLBFGS
+    nulow: float = 2.0
+    nuhigh: float = 30.0
+    randomize: bool = True
+    res_ratio: float = 5.0
+    abort_on_divergence: bool = False
+    resume: bool = False
+    checkpoint_every: int = 0
+    checkpoint_dir: Optional[str] = None
+    use_f64: bool = True
+    verbose: bool = False
+    slo: str = ""
+    max_streams: int = 8
+
+
+@dataclasses.dataclass
+class StreamConfig:
+    """``sagecal-tpu stream``: streaming/online calibration.  The
+    dataset is consumed as a time stream; each sliding window of
+    ``window`` time samples (advanced by ``hop``) is solved with a
+    warm start from the previous window's gains via the elastic
+    warm-start chain, minimizing latency-to-first-solution."""
+
+    dataset: str = ""           # vis.h5 consumed as a time stream
+    sky_model: str = ""
+    cluster_file: str = ""
+    out_dir: str = "stream-out"
+    window: int = 2             # time samples per sliding window
+    hop: int = 1                # samples the window advances per solve
+    max_windows: int = 0        # 0 = run to the end of the stream
+    warm_start: bool = True     # p0 <- previous window's solution
+    # iteration budget for warm-started windows (the chain means a
+    # near-converged start; full budgets only for the cold window 0)
+    warm_emiter: int = 1
+    warm_lbfgs: int = 0         # 0 = inherit max_lbfgs
+    in_column: str = "vis"
+    # solver (RunConfig semantics)
+    max_emiter: int = 3
+    max_iter: int = 2
+    max_lbfgs: int = 10
+    lbfgs_m: int = 7
+    solver_mode: int = SM_OSLM_OSRLM_RLBFGS
+    nulow: float = 2.0
+    nuhigh: float = 30.0
+    randomize: bool = True
+    res_ratio: float = 5.0
+    # elastic: lease-aware stream checkpoints — the checkpoint carries
+    # an owner lease so a second stream process refuses to adopt a
+    # LIVE peer's chain and only resumes one whose lease expired
+    resume: bool = False
+    checkpoint_every: int = 1
+    checkpoint_dir: Optional[str] = None
+    lease_ttl_s: float = 30.0
+    use_f64: bool = True
+    verbose: bool = False
+    # synthetic mode (tests/bench): simulate a make_sky fixture stream
+    synthetic: int = 0          # >0: nstations of the synthetic array
+    ntime: int = 6
+    nchan: int = 2
+    noise_sigma: float = 0.0
+    seed: int = 7

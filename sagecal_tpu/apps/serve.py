@@ -58,6 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
                     "falls back to a 'slos' key in the request manifest")
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--aot-store", default="",
+                    help="cross-worker AOT executable artifact store "
+                    "directory (serve/aot_store.py); workers joining a "
+                    "warm store compile nothing")
+    ap.add_argument("--max-streams", type=int, default=0,
+                    help="cap on concurrently open prefetch streams; "
+                    "LRU-evicted above the cap (0 = unbounded)")
     ap.add_argument("-V", "--verbose", action="store_true")
     return ap
 
@@ -72,7 +79,8 @@ def config_from_args(args) -> ServeConfig:
         abort_on_divergence=args.abort_on_divergence,
         resume=args.resume, checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir, use_f64=not args.f32,
-        verbose=args.verbose, slo=args.slo)
+        verbose=args.verbose, slo=args.slo, aot_store=args.aot_store,
+        max_streams=args.max_streams)
 
 
 def run_serve(cfg: ServeConfig, requests=None, log=print):
@@ -122,7 +130,13 @@ def _run_serve_host(cfg: ServeConfig, requests, log, accel):
     # request-lifecycle tracing (SAGECAL_TRACE=1): run-level spans join
     # the event stream on run_id; each request writes its own trace
     configure_tracer(run_id=manifest.run_id)
-    service = CalibrationService(cfg, log=log, device=accel)
+    store = None
+    if getattr(cfg, "aot_store", ""):
+        from sagecal_tpu.serve.aot_store import AOTArtifactStore
+
+        store = AOTArtifactStore(cfg.aot_store)
+    service = CalibrationService(cfg, log=log, device=accel,
+                                 aot_store=store)
     try:
         summary = service.run(requests, elog=elog)
     finally:

@@ -174,6 +174,32 @@ def find_latest_checkpoint(directory: str, log=None):
     return None
 
 
+def check_owner_lease(meta: Dict[str, Any], owner: str,
+                      now: Optional[float] = None) -> None:
+    """Refuse to adopt a checkpoint another process still owns.
+
+    Streaming/fleet runs stamp ``owner`` and ``lease_expires_at`` into
+    every checkpoint's meta (renewed simply by the checkpoint cadence).
+    A restarted or stolen-over process calls this before resuming: a
+    live lease held by a DIFFERENT owner means the original worker is
+    probably still writing, and adopting its state would fork the
+    stream.  An expired lease (or one we hold ourselves) is adoptable.
+    Raises :class:`ResumeRefused` on a live foreign lease; meta without
+    lease fields (single-process runs) always passes."""
+    holder = meta.get("owner")
+    if holder is None or holder == owner:
+        return
+    expires = meta.get("lease_expires_at")
+    if expires is None:
+        return
+    now = time.time() if now is None else float(now)
+    if float(expires) > now:
+        raise ResumeRefused(
+            f"checkpoint owned by {holder!r} with a live lease "
+            f"(expires in {float(expires) - now:.1f}s); refusing to "
+            f"adopt a stream another worker is still writing")
+
+
 class CheckpointManager:
     """Owns one run's checkpoint directory: cadence, retention, the
     final crash-time flush, and fingerprint-checked resume.

@@ -1,0 +1,31 @@
+"""Fleet serving: coordinator + N workers over a filesystem work queue.
+
+The single-process serve app (sagecal_tpu/serve/) drains one manifest
+in one process.  This package turns it into a fleet:
+
+- :mod:`~sagecal_tpu.fleet.queue` — the shared work queue: one item
+  file per request, claimed through atomic O_EXCL lease files with TTL
+  expiry, so a SIGKILL'd worker's requests requeue and exactly-once
+  *effects* come from atomic result-manifest writes rather than from
+  any coordination service.
+- :mod:`~sagecal_tpu.fleet.admission` — admission control consuming
+  :mod:`sagecal_tpu.obs.slo` burn rates: shed-or-degrade on overload,
+  closing the report-only loop of the SLO monitor.
+- :mod:`~sagecal_tpu.fleet.worker` — a claim-solve-complete loop that
+  reuses the serve scheduler for vmapped batch lanes and places large
+  solves on :func:`~sagecal_tpu.solvers.sharded.sharded_joint_fit`.
+- :mod:`~sagecal_tpu.fleet.coordinator` — seeds the queue, spawns the
+  workers, sweeps leases, and reports the merged fleet view.
+- :mod:`~sagecal_tpu.fleet.stream` — the streaming workload: sliding
+  windows over a visibility time stream, warm-started through the
+  elastic chain.
+
+Workers share compiled executables through the cross-worker AOT
+artifact store (serve/aot_store.py): the first worker to touch a
+bucket compiles and saves; every later worker loads, so a worker
+joining a warm fleet compiles nothing.
+"""
+
+from sagecal_tpu.fleet.queue import LeaseLost, LeaseQueue, WorkItem
+
+__all__ = ["LeaseQueue", "LeaseLost", "WorkItem"]

@@ -1,0 +1,254 @@
+"""The fleet coordinator: seed the queue, spawn workers, watch leases.
+
+The coordinator is deliberately thin — the queue's lease protocol does
+the actual scheduling, so the coordinator only has to
+
+1. **seed** the shared queue from a request manifest, stamping each
+   item with its scheduling metadata: the absolute deadline (enqueue
+   time + the tenant's SLO ``deadline_s``), a ``bucket_hint`` (the
+   coarse shape class, read once per dataset so workers can claim by
+   affinity without opening the HDF5 themselves), and the ``large``
+   placement flag (``nstations >= large_stations``);
+2. **spawn** N worker subprocesses (``sagecal-tpu fleet --role
+   worker``), each with a stable ``SAGECAL_WORKER_ID`` so metric
+   snapshots and lease files carry worker lineage;
+3. **watch** — poll queue stats (surfacing expired leases, i.e. dead
+   workers, which any live worker will steal), and finish when every
+   item has a done marker or every worker has exited;
+4. **report** the merged fleet view (obs/aggregate.py) plus post-hoc
+   SLO evaluation over the result manifests.
+
+Killing a worker (even SIGKILL) loses nothing: its leases expire,
+survivors steal and re-solve, and the atomic manifest writes keep the
+result set duplicate- and torn-free.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from sagecal_tpu.fleet.queue import LeaseQueue, WorkItem
+
+
+def bucket_hint_for(meta, tilesz: int, nchan_avg: bool = True) -> str:
+    """Coarse shape-affinity key for a request: enough to group
+    same-shape work (stations × tile × channels decide the compiled
+    program's shape class) without loading any sky model."""
+    nchan = 1 if nchan_avg else meta.nchan
+    return f"N{meta.nstations}xT{tilesz}xF{nchan}"
+
+
+def seed_queue(queue: LeaseQueue, requests, specs,
+               large_stations: int = 0,
+               log=print) -> List[WorkItem]:
+    """One WorkItem per request.  ``specs`` is the tenant SLO map
+    (deadline_s -> absolute EDF deadlines); datasets are opened once
+    each for their shape metadata."""
+    from sagecal_tpu.io.dataset import VisDataset
+
+    metas: Dict[str, Any] = {}
+    items: List[WorkItem] = []
+    now = time.time()
+    for r in requests:
+        path = os.path.abspath(r.dataset)
+        meta = metas.get(path)
+        if meta is None:
+            ds = VisDataset(path, "r")
+            meta = ds.meta
+            ds.close()
+            metas[path] = meta
+        spec = specs.get(r.tenant)
+        item = WorkItem(
+            request_id=r.request_id, tenant=r.tenant,
+            request={k: v for k, v in r.__dict__.items()},
+            deadline=(now + spec.deadline_s) if spec is not None
+            else float("inf"),
+            bucket_hint=bucket_hint_for(meta, r.tilesz),
+            enqueued_at=now,
+            large=bool(large_stations
+                       and meta.nstations >= large_stations))
+        queue.put(item)
+        items.append(item)
+    log(f"fleet: seeded {len(items)} requests into {queue.root} "
+        f"({len(metas)} datasets, "
+        f"{sum(1 for i in items if i.large)} large)")
+    return items
+
+
+def worker_argv(cfg, index: int) -> List[str]:
+    """The command line for one worker subprocess, reproducing the
+    coordinator's config with ``--role worker``."""
+    argv = [sys.executable, "-m", "sagecal_tpu.apps.fleet",
+            "--role", "worker",
+            "--requests", cfg.requests,
+            "--out-dir", cfg.out_dir,
+            "--queue-dir", cfg.queue_dir or
+            os.path.join(cfg.out_dir, "queue"),
+            "--aot-store", cfg.aot_store or
+            os.path.join(cfg.out_dir, "aot-store"),
+            "--worker-id", f"w{index}",
+            "--batch", str(cfg.batch),
+            "--lease-ttl", str(cfg.lease_ttl_s),
+            "--poll", str(cfg.poll_s),
+            "--max-idle", str(cfg.max_idle_s),
+            "--large-stations", str(cfg.large_stations),
+            "--overload-policy", cfg.overload_policy,
+            "--degrade-emiter", str(cfg.degrade_emiter),
+            "--degrade-lbfgs", str(cfg.degrade_lbfgs),
+            "--max-streams", str(cfg.max_streams),
+            "-e", str(cfg.max_emiter), "-g", str(cfg.max_iter),
+            "-l", str(cfg.max_lbfgs), "-m", str(cfg.lbfgs_m),
+            "-j", str(cfg.solver_mode)]
+    if cfg.slo:
+        argv += ["--slo", cfg.slo]
+    if not cfg.use_f64:
+        argv += ["--f32"]
+    if cfg.verbose:
+        argv += ["-V"]
+    return argv
+
+
+class FleetCoordinator:
+    """Seed + spawn + watch + report."""
+
+    def __init__(self, cfg, log=print):
+        self.cfg = cfg
+        self.log = log
+        self.queue = LeaseQueue(
+            cfg.queue_dir or os.path.join(cfg.out_dir, "queue"),
+            worker="coordinator", ttl_s=cfg.lease_ttl_s)
+        self.procs: List[subprocess.Popen] = []
+
+    def spawn_workers(self, n: Optional[int] = None) -> None:
+        n = self.cfg.workers if n is None else n
+        for i in range(n):
+            env = dict(os.environ, SAGECAL_WORKER_ID=f"w{i}")
+            # the fleet view (compile/AOT-hit accounting, snapshots) is
+            # metrics-registry-driven, and the registry is telemetry-
+            # gated — default it ON for workers; an explicit operator
+            # setting (even "0") still wins
+            env.setdefault("SAGECAL_TELEMETRY", "1")
+            self.procs.append(subprocess.Popen(
+                worker_argv(self.cfg, i), env=env))
+        self.log(f"fleet: spawned {n} workers "
+                 f"(pids {[p.pid for p in self.procs]})")
+
+    def watch(self, timeout_s: float = 0.0,
+              poll_s: float = 1.0) -> bool:
+        """Poll until every item is done or every worker exited.
+        Returns True iff the queue fully drained."""
+        t0 = time.time()
+        last_stats = ""
+        while True:
+            if self.queue.all_done():
+                return True
+            alive = [p for p in self.procs if p.poll() is None]
+            stats = self.queue.stats()
+            line = (f"fleet: {stats['done']}/{stats['items']} done, "
+                    f"{stats['leased']} leased, "
+                    f"{stats['expired_leases']} expired leases, "
+                    f"{len(alive)} workers alive")
+            if line != last_stats:
+                self.log(line)
+                last_stats = line
+            if not alive:
+                return self.queue.all_done()
+            if timeout_s and time.time() - t0 > timeout_s:
+                return self.queue.all_done()
+            time.sleep(poll_s)
+
+    def shutdown(self, grace_s: float = 10.0) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + grace_s
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(deadline - time.time(), 0.1))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
+    def summary(self, requests) -> Dict[str, Any]:
+        """Merged fleet view + post-hoc SLO evaluation."""
+        from sagecal_tpu.obs.aggregate import (
+            read_result_manifests, state_counter_total,
+        )
+        from sagecal_tpu.obs.aggregate import (
+            dedupe_snapshots, merge_states, read_metrics_snapshots,
+        )
+        from sagecal_tpu.obs.slo import evaluate_results, load_slo_specs
+
+        results = read_result_manifests(self.cfg.out_dir)
+        snaps = dedupe_snapshots(
+            read_metrics_snapshots(self.cfg.out_dir))
+        state = merge_states(d["state"] for d in snaps)
+        lat = sorted(float(r.get("latency_s", 0.0)) for r in results
+                     if r.get("verdict") not in ("shed",))
+        specs = {}
+        if self.cfg.slo:
+            specs = load_slo_specs(self.cfg.slo)
+        elif self.cfg.requests and os.path.exists(self.cfg.requests):
+            specs = load_slo_specs(self.cfg.requests)
+        out = {
+            "requests": len(requests),
+            "manifests": len(results),
+            "done": self.queue.stats()["done"],
+            "shed": sum(1 for r in results
+                        if r.get("verdict") == "shed"),
+            "degraded": sum(1 for r in results if r.get("degraded")),
+            "errors": sum(1 for r in results
+                          if r.get("verdict") == "error"),
+            "workers": len(self.procs),
+            "snapshots": len(snaps),
+            "fleet_compiles": state_counter_total(
+                state, "serve_executable_cache_compiles_total"),
+            "fleet_aot_hits": state_counter_total(
+                state, "serve_executable_cache_aot_hits_total"),
+            "p50_latency_s": lat[len(lat) // 2] if lat else 0.0,
+            "p95_latency_s": lat[int(len(lat) * 0.95)] if lat else 0.0,
+        }
+        if specs:
+            out["slo"] = evaluate_results(specs, results)
+        return out
+
+    def run(self, requests, elog=None) -> Dict[str, Any]:
+        from sagecal_tpu.obs.slo import load_slo_specs
+
+        t0 = time.time()
+        os.makedirs(self.cfg.out_dir, exist_ok=True)
+        specs = {}
+        if self.cfg.slo:
+            specs = load_slo_specs(self.cfg.slo)
+        elif self.cfg.requests and os.path.exists(self.cfg.requests):
+            specs = load_slo_specs(self.cfg.requests)
+        seed_queue(self.queue, requests, specs,
+                   large_stations=self.cfg.large_stations,
+                   log=self.log)
+        if elog is not None:
+            elog.emit("fleet_seeded", n=len(requests),
+                      queue=self.queue.root,
+                      workers=self.cfg.workers)
+        try:
+            self.spawn_workers()
+            drained = self.watch()
+        finally:
+            self.shutdown()
+        summary = self.summary(requests)
+        summary["drained"] = drained
+        summary["wall_s"] = time.time() - t0
+        if elog is not None:
+            elog.emit("fleet_done", **{
+                k: v for k, v in summary.items() if k != "slo"})
+        self.log(
+            f"fleet: {summary['done']}/{summary['requests']} done "
+            f"({summary['shed']} shed, {summary['degraded']} degraded, "
+            f"{summary['errors']} errors) in {summary['wall_s']:.1f}s; "
+            f"{summary['fleet_compiles']:g} compiles / "
+            f"{summary['fleet_aot_hits']:g} AOT hits fleet-wide")
+        return summary

@@ -1,0 +1,333 @@
+"""Filesystem work queue with atomic lease files.
+
+The queue is a directory three kinds of file live in, one per request:
+
+- ``item-<rid>.json`` — the work item (the request dict plus scheduling
+  metadata: absolute ``deadline``, ``bucket_hint``, ``enqueued_at``).
+  Written once by the coordinator, never mutated.
+- ``lease-<rid>.json`` — present while some worker holds the claim:
+  ``{worker, acquired_at, expires_at}``.  Created with
+  ``O_CREAT|O_EXCL`` (the atomic claim — exactly one creator wins),
+  renewed via tmp + ``os.replace`` (readers never see a torn lease),
+  and *stolen* after expiry by renaming it to a unique tombstone first
+  (rename is atomic, so exactly one stealer wins even when several
+  workers notice the same dead lease) and then re-creating with
+  ``O_EXCL``.
+- ``done-<rid>.json`` — the completion marker, written atomically
+  AFTER the result manifest is on disk.  Claims check it first and
+  last, so a request completed between a steal decision and the new
+  lease is released untouched.
+
+Exactly-once *effects* come from the result-manifest layer, not the
+queue: a zombie worker whose lease was stolen may finish its solve in
+parallel with the stealer, but both write the same deterministic
+result (per-request RNG is derived from the request id and vmapped
+lanes are independent) through atomic ``os.replace``, so the manifest
+set contains no duplicates and no torn files.
+
+Claim ordering is deadline-first (EDF) with bucket affinity: a worker
+prefers items whose ``bucket_hint`` it has already compiled/claimed —
+that is what lets same-shape requests land on the same worker and fill
+its vmapped batch lanes — but never at the cost of an earlier deadline
+in a different bucket beyond the batch window.
+
+Everything here is stdlib-only and safe on any POSIX filesystem with
+atomic rename (the same contract the elastic checkpoints rely on).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Set
+
+ITEM_PREFIX = "item-"
+LEASE_PREFIX = "lease-"
+DONE_PREFIX = "done-"
+FAIL_PREFIX = "fail-"
+
+
+class LeaseLost(RuntimeError):
+    """Raised by :meth:`LeaseQueue.renew` when the caller's lease no
+    longer exists or is held by another worker (it expired and was
+    stolen).  The holder must treat the request as no longer its own."""
+
+
+@dataclasses.dataclass
+class WorkItem:
+    """One queued request plus its scheduling metadata."""
+
+    request_id: str
+    tenant: str
+    request: Dict[str, Any]     # the SolveRequest fields, verbatim
+    deadline: float = math.inf  # absolute unix deadline (EDF key)
+    bucket_hint: str = ""       # shape-affinity key (coordinator-set)
+    enqueued_at: float = 0.0
+    large: bool = False         # place via sharded_joint_fit
+
+    def to_doc(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        if math.isinf(self.deadline):
+            d["deadline"] = None
+        return d
+
+    @classmethod
+    def from_doc(cls, doc: Dict[str, Any]) -> "WorkItem":
+        d = dict(doc)
+        if d.get("deadline") is None:
+            d["deadline"] = math.inf
+        return cls(**{k: d[k] for k in
+                      ("request_id", "tenant", "request", "deadline",
+                       "bucket_hint", "enqueued_at", "large") if k in d})
+
+
+def _atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, sort_keys=True, default=float)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+class LeaseQueue:
+    """One worker's (or the coordinator's) handle on a shared queue
+    directory.  All methods are safe to call concurrently from any
+    number of processes."""
+
+    def __init__(self, root: str, worker: Optional[str] = None,
+                 ttl_s: float = 30.0):
+        from sagecal_tpu.obs.aggregate import worker_id
+
+        self.root = root
+        self.worker = worker or worker_id()
+        self.ttl_s = float(ttl_s)
+        os.makedirs(root, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------
+
+    def item_path(self, rid: str) -> str:
+        return os.path.join(self.root, f"{ITEM_PREFIX}{rid}.json")
+
+    def lease_path(self, rid: str) -> str:
+        return os.path.join(self.root, f"{LEASE_PREFIX}{rid}.json")
+
+    def done_path(self, rid: str) -> str:
+        return os.path.join(self.root, f"{DONE_PREFIX}{rid}.json")
+
+    # -- producer side -------------------------------------------------
+
+    def put(self, item: WorkItem) -> str:
+        if not item.enqueued_at:
+            item.enqueued_at = time.time()
+        path = self.item_path(item.request_id)
+        _atomic_write_json(path, item.to_doc())
+        return path
+
+    # -- introspection -------------------------------------------------
+
+    def items(self) -> List[WorkItem]:
+        out: List[WorkItem] = []
+        for name in sorted(os.listdir(self.root)):
+            if not (name.startswith(ITEM_PREFIX)
+                    and name.endswith(".json")):
+                continue
+            doc = _read_json(os.path.join(self.root, name))
+            if doc and doc.get("request_id"):
+                out.append(WorkItem.from_doc(doc))
+        return out
+
+    def done_ids(self) -> Set[str]:
+        n, s = len(DONE_PREFIX), len(".json")
+        return {name[n:-s] for name in os.listdir(self.root)
+                if name.startswith(DONE_PREFIX)
+                and name.endswith(".json")}
+
+    def read_lease(self, rid: str) -> Optional[Dict[str, Any]]:
+        return _read_json(self.lease_path(rid))
+
+    def read_done(self, rid: str) -> Optional[Dict[str, Any]]:
+        return _read_json(self.done_path(rid))
+
+    def pending(self, now: Optional[float] = None) -> List[WorkItem]:
+        """Items with no done marker and no LIVE lease, i.e. claimable
+        right now (unleased, or leased-but-expired)."""
+        now = time.time() if now is None else float(now)
+        done = self.done_ids()
+        out: List[WorkItem] = []
+        for it in self.items():
+            if it.request_id in done:
+                continue
+            lease = self.read_lease(it.request_id)
+            if lease is not None \
+                    and float(lease.get("expires_at", 0.0)) > now:
+                continue
+            out.append(it)
+        return out
+
+    def stats(self, now: Optional[float] = None) -> Dict[str, int]:
+        now = time.time() if now is None else float(now)
+        items = self.items()
+        done = self.done_ids()
+        leased = expired = 0
+        for it in items:
+            if it.request_id in done:
+                continue
+            lease = self.read_lease(it.request_id)
+            if lease is None:
+                continue
+            if float(lease.get("expires_at", 0.0)) > now:
+                leased += 1
+            else:
+                expired += 1
+        return {"items": len(items),
+                "done": sum(1 for i in items if i.request_id in done),
+                "leased": leased, "expired_leases": expired}
+
+    def all_done(self) -> bool:
+        done = self.done_ids()
+        return all(it.request_id in done for it in self.items())
+
+    # -- claim protocol ------------------------------------------------
+
+    def claim(self, rid: str, now: Optional[float] = None) -> bool:
+        """Try to acquire the lease on one request.  True iff THIS
+        worker now holds it.  Never blocks, never raises on contention."""
+        now = time.time() if now is None else float(now)
+        if os.path.exists(self.done_path(rid)):
+            return False
+        lpath = self.lease_path(rid)
+        lease = _read_json(lpath)
+        if lease is not None:
+            if float(lease.get("expires_at", 0.0)) > now:
+                return False
+            # expired: steal via unique-tombstone rename — atomic, so
+            # of N workers racing on the same dead lease exactly one
+            # rename succeeds and the rest fall through to the O_EXCL
+            # create below (which the winner also races for, fairly)
+            tomb = f"{lpath}.expired.{uuid.uuid4().hex[:8]}"
+            try:
+                os.rename(lpath, tomb)
+            except OSError:
+                pass
+            else:
+                try:
+                    os.unlink(tomb)
+                except OSError:
+                    pass
+        try:
+            fd = os.open(lpath, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        except OSError:
+            return False
+        try:
+            doc = {"worker": self.worker, "request_id": rid,
+                   "acquired_at": now, "renewed_at": now,
+                   "expires_at": now + self.ttl_s}
+            os.write(fd, (json.dumps(doc, sort_keys=True) + "\n")
+                     .encode("utf-8"))
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        if os.path.exists(self.done_path(rid)):
+            # completed between our expiry check and the create: the
+            # work is finished, back out
+            self.release(rid)
+            return False
+        return True
+
+    def renew(self, rid: str, now: Optional[float] = None) -> float:
+        """Extend this worker's lease by ``ttl_s``.  Returns the new
+        expiry; raises :class:`LeaseLost` when the lease is gone or
+        held by someone else (stolen after expiry)."""
+        now = time.time() if now is None else float(now)
+        lpath = self.lease_path(rid)
+        lease = _read_json(lpath)
+        if lease is None or lease.get("worker") != self.worker:
+            raise LeaseLost(
+                f"lease on {rid} lost (now held by "
+                f"{(lease or {}).get('worker', 'nobody')!r})")
+        lease["renewed_at"] = now
+        lease["expires_at"] = now + self.ttl_s
+        _atomic_write_json(lpath, lease)
+        return lease["expires_at"]
+
+    def release(self, rid: str) -> None:
+        try:
+            os.unlink(self.lease_path(rid))
+        except OSError:
+            pass
+
+    def complete(self, rid: str, **info) -> str:
+        """Write the done marker (atomic) and drop the lease.  Call
+        only after the request's result manifest is on disk."""
+        path = self.done_path(rid)
+        _atomic_write_json(path, dict(info, request_id=rid,
+                                      worker=self.worker,
+                                      completed_at=time.time()))
+        self.release(rid)
+        return path
+
+    # -- failure accounting -------------------------------------------
+
+    def record_failure(self, rid: str, error: str) -> int:
+        """Leave a durable failure marker for one solve attempt (one
+        unique file per attempt, so markers from concurrent workers
+        never clobber each other) and return the total attempt count.
+        Workers release a failed lease for retry until the count
+        reaches their attempt budget, then complete the request with an
+        error manifest so a poisoned input can't loop forever."""
+        path = os.path.join(
+            self.root,
+            f"{FAIL_PREFIX}{rid}.{uuid.uuid4().hex[:8]}.json")
+        _atomic_write_json(path, {
+            "request_id": rid, "worker": self.worker,
+            "ts": time.time(), "error": str(error)[:2000]})
+        return self.failure_count(rid)
+
+    def failure_count(self, rid: str) -> int:
+        prefix = f"{FAIL_PREFIX}{rid}."
+        return sum(1 for name in os.listdir(self.root)
+                   if name.startswith(prefix) and name.endswith(".json"))
+
+    # -- scheduling ----------------------------------------------------
+
+    def select(self, affinity: Set[str] = frozenset(),
+               limit: int = 1, now: Optional[float] = None,
+               affinity_window_s: float = 10.0) -> List[WorkItem]:
+        """Claim candidates in scheduling order: earliest deadline
+        first (EDF), with bucket affinity deciding WITHIN a deadline
+        window — two items due within ``affinity_window_s`` of each
+        other are interchangeable deadline-wise, so the worker prefers
+        the one whose shape it already holds an executable for (filling
+        its vmapped batch lanes) without ever jumping a strictly
+        earlier deadline window.  Does NOT claim — callers iterate the
+        returned order and :meth:`claim`."""
+        cands = self.pending(now)
+        w = max(float(affinity_window_s), 1e-9)
+
+        def key(it: WorkItem):
+            dwin = math.floor(it.deadline / w) \
+                if math.isfinite(it.deadline) else math.inf
+            return (dwin,
+                    0 if it.bucket_hint and it.bucket_hint in affinity
+                    else 1,
+                    it.deadline, it.enqueued_at, it.request_id)
+
+        cands.sort(key=key)
+        return cands[:max(int(limit), 0)] if limit else cands

@@ -1,0 +1,435 @@
+"""The fleet worker: claim -> admit -> solve -> complete.
+
+One worker process runs this loop against the shared
+:class:`~sagecal_tpu.fleet.queue.LeaseQueue`:
+
+1. **scan** the shared out_dir so admission control sees every
+   worker's completions (burn state converges fleet-wide without a
+   central scheduler);
+2. **claim** up to ``batch`` requests in EDF + bucket-affinity order,
+   restricted to one ``bucket_hint`` per cycle so the claims stack
+   into full vmapped batch lanes;
+3. **admit** each claimed request (accept / degrade / shed per the
+   tenant's SLO burn);
+4. **solve** — small requests ride the serve scheduler
+   (:class:`~sagecal_tpu.serve.service.CalibrationService`) with this
+   worker's persistent :class:`~sagecal_tpu.serve.cache.
+   ExecutableCache` injected (in-process tier + the cross-worker AOT
+   artifact store, so only the FIRST worker in the fleet ever
+   compiles a bucket); large requests (``nstations >=
+   large_stations`` with >1 local device) are placed on
+   :func:`~sagecal_tpu.solvers.sharded.sharded_joint_fit`;
+5. **complete** — done markers written only after the result
+   manifests are on disk.  A lease this worker lost mid-solve (it
+   stalled past the TTL and another worker stole the request) is NOT
+   completed here; both workers' manifests are deterministic-identical
+   and atomic, so the stolen request still yields exactly one
+   manifest.
+
+Failed attempts leave durable failure markers; after ``MAX_ATTEMPTS``
+the worker writes an error manifest and completes the request, so one
+poisoned input can't wedge the fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from sagecal_tpu.fleet.admission import build_controller
+from sagecal_tpu.fleet.queue import LeaseLost, LeaseQueue, WorkItem
+
+#: solve attempts per request before it is completed as an error
+MAX_ATTEMPTS = 3
+
+
+def _request_from_item(item: WorkItem):
+    from sagecal_tpu.serve.request import SolveRequest
+
+    fields = {f.name for f in dataclasses.fields(SolveRequest)}
+    return SolveRequest(**{k: v for k, v in item.request.items()
+                           if k in fields})
+
+
+class FleetWorker:
+    """One claim-solve-complete loop over the shared queue."""
+
+    def __init__(self, cfg, log=print, device=None):
+        from sagecal_tpu.obs.aggregate import worker_id
+        from sagecal_tpu.serve.aot_store import AOTArtifactStore
+        from sagecal_tpu.serve.cache import ExecutableCache
+
+        self.cfg = cfg
+        self.log = log
+        self.device = device
+        self.wid = cfg.worker_id or worker_id()
+        self.queue = LeaseQueue(
+            cfg.queue_dir or os.path.join(cfg.out_dir, "queue"),
+            worker=self.wid, ttl_s=cfg.lease_ttl_s)
+        self.store = AOTArtifactStore(
+            cfg.aot_store or os.path.join(cfg.out_dir, "aot-store"))
+        # ONE executable cache for the worker's whole life: the
+        # in-process tier survives across claim cycles, the store tier
+        # shares compiles across the fleet
+        self.cache = ExecutableCache(store=self.store)
+        self.admission = build_controller(cfg, cfg.requests)
+        self.affinity: Set[str] = set()
+        self._held: Set[str] = set()
+        self._lost: Set[str] = set()
+        self._hold_lock = threading.Lock()
+        self.cycles = 0
+        self.solved = 0
+
+    # -- config plumbing ----------------------------------------------
+
+    def _serve_cfg(self):
+        """The ServeConfig one claim cycle's CalibrationService runs
+        under.  Elastic checkpointing is OFF on purpose: the queue's
+        done markers are the fleet's durable progress record, so a
+        restarted worker re-claims instead of resuming."""
+        from sagecal_tpu.apps.config import ServeConfig
+
+        c = self.cfg
+        return ServeConfig(
+            requests="", out_dir=c.out_dir, batch=c.batch,
+            max_emiter=c.max_emiter, max_iter=c.max_iter,
+            max_lbfgs=c.max_lbfgs, lbfgs_m=c.lbfgs_m,
+            solver_mode=c.solver_mode, nulow=c.nulow, nuhigh=c.nuhigh,
+            randomize=c.randomize, res_ratio=c.res_ratio,
+            abort_on_divergence=False, resume=False,
+            checkpoint_every=0, checkpoint_dir=None,
+            use_f64=c.use_f64, verbose=c.verbose, slo="",
+            max_streams=c.max_streams)
+
+    # -- lease upkeep --------------------------------------------------
+
+    def _renew_loop(self, stop: threading.Event) -> None:
+        period = self.cfg.lease_renew_s or self.cfg.lease_ttl_s / 3.0
+        while not stop.wait(max(period, 0.05)):
+            with self._hold_lock:
+                held = list(self._held)
+            for rid in held:
+                try:
+                    self.queue.renew(rid)
+                except LeaseLost:
+                    with self._hold_lock:
+                        self._held.discard(rid)
+                        self._lost.add(rid)
+                except OSError:
+                    pass
+
+    def _drop(self, rid: str) -> None:
+        with self._hold_lock:
+            self._held.discard(rid)
+
+    # -- claiming ------------------------------------------------------
+
+    def claim_cycle(self) -> List[WorkItem]:
+        """Claim up to ``batch`` requests sharing one bucket hint."""
+        cands = self.queue.select(
+            self.affinity, limit=max(self.cfg.batch * 4, 8))
+        claimed: List[WorkItem] = []
+        hint: Optional[str] = None
+        for it in cands:
+            if hint is not None and it.bucket_hint != hint:
+                continue
+            if self.queue.claim(it.request_id):
+                claimed.append(it)
+                hint = it.bucket_hint
+                if it.bucket_hint:
+                    self.affinity.add(it.bucket_hint)
+                if len(claimed) >= self.cfg.batch:
+                    break
+        return claimed
+
+    # -- solving -------------------------------------------------------
+
+    def _solve_small(self, items: List[Tuple[WorkItem, bool]],
+                     elog) -> None:
+        from sagecal_tpu.serve.service import CalibrationService
+
+        reqs = [_request_from_item(it) for it, _ in items]
+        svc = CalibrationService(self._serve_cfg(), log=self.log,
+                                 device=self.device)
+        svc.cache = self.cache  # persistent in-proc + AOT store tiers
+        svc.run(reqs, elog=elog)
+        for it, degraded in items:
+            if degraded:
+                self._annotate_degraded(it.request_id)
+
+    def _annotate_degraded(self, rid: str) -> None:
+        """Stamp ``degraded: true`` into an existing result manifest
+        (atomic rewrite) so tenants can see which results were
+        produced under admission pressure."""
+        import json
+
+        from sagecal_tpu.serve.request import (
+            result_manifest_path, write_result_manifest,
+        )
+
+        path = result_manifest_path(self.cfg.out_dir, rid)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return
+        doc["degraded"] = True
+        doc["degrade_emiter"] = self.admission.degrade_emiter
+        doc["degrade_lbfgs"] = self.admission.degrade_lbfgs
+        write_result_manifest(self.cfg.out_dir, doc)
+
+    def _can_shard(self) -> bool:
+        import jax
+
+        return self.cfg.large_stations > 0 and len(jax.devices()) > 1
+
+    def _solve_large(self, item: WorkItem, degraded: bool,
+                     elog) -> None:
+        """Place one large solve on the row-sharded joint-LBFGS path
+        across every local device (instead of a vmapped batch lane)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from sagecal_tpu.core.types import (
+            identity_jones, jones_to_params, params_to_jones,
+        )
+        from sagecal_tpu.io import solutions as solio
+        from sagecal_tpu.io.dataset import VisDataset
+        from sagecal_tpu.io.skymodel import load_sky
+        from sagecal_tpu.obs.quality import check_and_emit
+        from sagecal_tpu.serve.request import write_result_manifest
+        from sagecal_tpu.solvers.sage import build_cluster_data
+        from sagecal_tpu.solvers.sharded import (
+            pad_rows_to, sharded_joint_fit,
+        )
+
+        req = _request_from_item(item)
+        cfg = self.cfg
+        t_start = time.time()
+        dtype = np.float64 if cfg.use_f64 else np.float32
+        cdtype = np.complex128 if cfg.use_f64 else np.complex64
+        with VisDataset(req.dataset, "r") as ds:
+            meta = ds.meta
+            data = ds.load_tile(req.t0, req.tilesz, dtype=dtype,
+                                column=req.in_column)
+        clusters, cdefs, shapelets = load_sky(
+            req.sky_model, req.cluster_file, meta.ra0, meta.dec0,
+            dtype=dtype)
+        nchunks = [cd.nchunk for cd in cdefs]
+        nchunk_max = max(nchunks)
+        M, N = len(clusters), meta.nstations
+        cdata = build_cluster_data(data, clusters, nchunks,
+                                   shapelets=shapelets)
+        eye = jones_to_params(identity_jones(N, cdtype))
+        p0 = jnp.broadcast_to(
+            eye, (M, nchunk_max, 8 * N)).astype(dtype)
+        devs = np.asarray(jax.devices())
+        data, cdata = pad_rows_to(data, cdata, len(devs))
+        mesh = Mesh(devs, ("rows",))
+        itmax = (self.admission.degrade_lbfgs if degraded
+                 else cfg.max_lbfgs)
+        out = sharded_joint_fit(data, cdata, p0, mesh,
+                                itmax=itmax, lbfgs_m=cfg.lbfgs_m,
+                                collect_quality=True)
+        p, cost, iterations, quality = out
+        verdict, reasons = check_and_emit(
+            elog, jax.tree_util.tree_map(np.asarray, quality),
+            log=self.log, tile=req.t0, app="fleet",
+            tenant=req.tenant, request_id=req.request_id)
+        out_path = req.out_solutions or os.path.join(
+            cfg.out_dir, f"{req.request_id}.solutions")
+        jsol = np.asarray(params_to_jones(np.asarray(p))).reshape(
+            M * nchunk_max, N, 2, 2)
+        with open(out_path, "w") as fh:
+            solio.write_header(
+                fh, meta.freq0, meta.deltaf,
+                meta.deltat * req.tilesz / 60.0, N, M, M * nchunk_max)
+            solio.append_solutions(fh, jsol)
+        now = time.time()
+        result = {
+            "request_id": req.request_id, "tenant": req.tenant,
+            "dataset": req.dataset, "t0": req.t0,
+            "tilesz": req.tilesz, "verdict": verdict,
+            "reasons": reasons, "res_0": float(cost),
+            "res_1": float(cost), "mean_nu": 0.0,
+            "bucket": f"sharded:{len(devs)}dev", "batch": 1, "lane": 0,
+            "placed": "sharded_joint_fit",
+            "iterations": int(iterations),
+            "solutions": out_path,
+            "enqueued_at": item.enqueued_at, "started_at": t_start,
+            "completed_at": now,
+            "queue_wait_s": max(t_start - item.enqueued_at, 0.0),
+            "latency_s": now - item.enqueued_at,
+            "trace_id": req.trace_id,
+        }
+        if degraded:
+            result["degraded"] = True
+        write_result_manifest(cfg.out_dir, result)
+        if elog is not None:
+            elog.emit("request_done", **result)
+
+    # -- one cycle -----------------------------------------------------
+
+    def process(self, claimed: List[WorkItem], elog=None) -> int:
+        """Admit + solve + complete one batch of claimed requests.
+        Returns how many completed."""
+        from sagecal_tpu.serve.request import (
+            result_manifest_path, write_result_manifest,
+        )
+
+        with self._hold_lock:
+            self._held = {it.request_id for it in claimed}
+            self._lost = set()
+        stop = threading.Event()
+        renewer = threading.Thread(
+            target=self._renew_loop, args=(stop,), daemon=True,
+            name=f"lease-renew-{self.wid}")
+        renewer.start()
+        done = 0
+        try:
+            self.admission.ingest_dir(self.cfg.out_dir)
+            to_solve: List[Tuple[WorkItem, bool]] = []
+            for it in claimed:
+                decision, detail = self.admission.decide(it.tenant)
+                if decision == "shed":
+                    self.admission.shed_result(
+                        it, self.cfg.out_dir, detail)
+                    if elog is not None:
+                        elog.emit("request_shed",
+                                  request_id=it.request_id,
+                                  tenant=it.tenant, worker=self.wid,
+                                  **detail)
+                    self.queue.complete(it.request_id, verdict="shed")
+                    self._drop(it.request_id)
+                    done += 1
+                    continue
+                if decision == "degrade":
+                    it.request = self.admission.degrade_request(
+                        it.request)
+                    if elog is not None:
+                        elog.emit("request_degraded",
+                                  request_id=it.request_id,
+                                  tenant=it.tenant, worker=self.wid,
+                                  **detail)
+                to_solve.append((it, decision == "degrade"))
+
+            small = [(it, d) for it, d in to_solve
+                     if not (it.large and self._can_shard())]
+            large = [(it, d) for it, d in to_solve
+                     if it.large and self._can_shard()]
+            try:
+                if small:
+                    self._solve_small(small, elog)
+                for it, d in large:
+                    self._solve_large(it, d, elog)
+            except Exception as e:  # noqa: BLE001 — fleet must survive
+                self.log(f"worker {self.wid}: solve cycle failed: "
+                         f"{e!r}")
+                for it, _ in to_solve:
+                    rid = it.request_id
+                    if rid in self._lost:
+                        continue
+                    attempts = self.queue.record_failure(rid, repr(e))
+                    if attempts >= MAX_ATTEMPTS:
+                        now = time.time()
+                        write_result_manifest(self.cfg.out_dir, {
+                            "request_id": rid, "tenant": it.tenant,
+                            "verdict": "error",
+                            "reasons": [f"attempts={attempts}",
+                                        repr(e)[:500]],
+                            "enqueued_at": it.enqueued_at,
+                            "started_at": now, "completed_at": now,
+                            "queue_wait_s": 0.0,
+                            "latency_s": max(now - it.enqueued_at,
+                                             0.0),
+                        })
+                        self.queue.complete(rid, verdict="error")
+                        done += 1
+                    else:
+                        self.queue.release(rid)
+                    self._drop(rid)
+                return done
+
+            for it, _ in to_solve:
+                rid = it.request_id
+                if rid in self._lost:
+                    # stolen mid-solve: the stealer owns completion
+                    continue
+                manifest = result_manifest_path(self.cfg.out_dir, rid)
+                if os.path.exists(manifest):
+                    self.queue.complete(rid, manifest=manifest)
+                    self.solved += 1
+                    done += 1
+                else:
+                    self.queue.release(rid)
+                self._drop(rid)
+        finally:
+            stop.set()
+            renewer.join(timeout=5.0)
+            with self._hold_lock:
+                for rid in list(self._held):
+                    self.queue.release(rid)
+                self._held = set()
+        return done
+
+    # -- the loop ------------------------------------------------------
+
+    def run(self, elog=None) -> Dict[str, Any]:
+        from sagecal_tpu.obs.registry import get_registry
+
+        cfg, reg = self.cfg, get_registry()
+        os.makedirs(cfg.out_dir, exist_ok=True)
+        t0 = time.time()
+        idle_since: Optional[float] = None
+        while True:
+            claimed = self.claim_cycle()
+            if claimed:
+                idle_since = None
+                self.cycles += 1
+                if elog is not None:
+                    elog.emit("fleet_claimed", worker=self.wid,
+                              n=len(claimed),
+                              hint=claimed[0].bucket_hint,
+                              ids=[it.request_id for it in claimed])
+                self.process(claimed, elog=elog)
+                continue
+            if self.queue.all_done():
+                break
+            now = time.time()
+            if idle_since is None:
+                idle_since = now
+            elif now - idle_since > cfg.max_idle_s:
+                # nothing claimable for a while (live leases held by
+                # peers): let the coordinator's view decide the end
+                break
+            time.sleep(cfg.poll_s)
+        wall = time.time() - t0
+        summary = {
+            "worker": self.wid, "cycles": self.cycles,
+            "solved": self.solved, "wall_s": wall,
+            "cache": self.cache.stats(),
+            "admission": dict(self.admission.decisions),
+        }
+        if reg.enabled:
+            from sagecal_tpu.obs.aggregate import (
+                metrics_snapshot_path, write_metrics_snapshot,
+            )
+
+            try:
+                write_metrics_snapshot(
+                    metrics_snapshot_path(cfg.out_dir, self.wid),
+                    registry=reg)
+            except OSError:
+                pass
+        if elog is not None:
+            elog.emit("fleet_worker_done", **summary)
+        self.log(f"worker {self.wid}: {self.solved} solved in "
+                 f"{self.cycles} cycles ({wall:.1f}s), "
+                 f"cache {self.cache.stats()}, "
+                 f"admission {self.admission.decisions}")
+        return summary

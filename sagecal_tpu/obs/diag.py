@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List, Optional
 
@@ -452,6 +453,35 @@ def _cmd_serve(args) -> int:
         total = hits + misses
         print(f"\nexecutable cache: {hits:g} hits / {misses:g} misses "
               f"({hits / total:.1%} hit ratio, fleet-wide)")
+    aot = {k: state_counter_total(
+        state, f"serve_executable_cache_aot_{k}_total")
+        for k in ("hits", "misses", "errors", "saves")}
+    if any(aot.values()):
+        print(f"AOT artifact store: {aot['hits']:g} loads / "
+              f"{aot['saves']:g} saves / {aot['misses']:g} misses / "
+              f"{aot['errors']:g} bad artifacts (recompiled)")
+
+    # -- fleet lease queue (auto-detected <out_dir>/queue, the fleet
+    # coordinator's default layout): claim/steal health at a glance
+    for d in out_dirs:
+        qdir = os.path.join(d, "queue")
+        if not os.path.isdir(qdir):
+            continue
+        from sagecal_tpu.fleet.queue import LeaseQueue
+
+        q = LeaseQueue(qdir, worker="diag")
+        st = q.stats()
+        fails = sum(q.failure_count(i.request_id) for i in q.items())
+        print(f"\nlease queue {qdir}: {st['done']}/{st['items']} done, "
+              f"{st['leased']} live leases, "
+              f"{st['expired_leases']} expired leases (stealable), "
+              f"{fails} failure markers")
+        if st["expired_leases"]:
+            for it in q.pending():
+                lease = q.read_lease(it.request_id)
+                if lease is not None:
+                    print(f"  EXPIRED: {it.request_id} held by "
+                          f"{lease.get('worker', '?')}")
 
     # -- queue-depth timeline from manifests alone
     line = queue_depth_timeline(results, max_points=args.timeline_points)

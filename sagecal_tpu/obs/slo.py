@@ -199,12 +199,17 @@ class SLOMonitor:
             out.append(status)
         return out
 
-    def shed_recommended(self, tenant: str) -> bool:
+    def shed_recommended(self, tenant: str,
+                         now: Optional[float] = None) -> bool:
+        """True while the tenant's short-window burn exceeds its
+        ``shed_burn`` threshold.  ``now`` pins the evaluation instant
+        (admission-control tests replay recorded sample streams)."""
         spec = self.specs.get(tenant)
         if spec is None:
             return False
         status = evaluate_window_burns(
-            spec, self._samples[tenant], time.time())
+            spec, self._samples[tenant],
+            time.time() if now is None else float(now))
         return status["shed_recommended"]
 
 

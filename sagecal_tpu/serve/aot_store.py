@@ -1,0 +1,199 @@
+"""Persistent cross-worker AOT executable artifact store.
+
+The in-process :class:`~sagecal_tpu.serve.cache.ExecutableCache` makes
+the SECOND batch of a bucket free; this store makes the second WORKER
+free.  Each artifact is one serialized compiled executable
+(``jax.experimental.serialize_executable``) written by whichever fleet
+worker compiled the bucket first; any worker that touches the same
+bucket later deserializes and loads it — **zero compiles**, pinned by
+the ``serve_executable_cache_*`` counters (a loaded worker records
+``aot_hits`` and no ``compiles``).
+
+Key contract: an artifact is only valid for the exact program it was
+compiled from, so the key digests
+
+- the complete :class:`~sagecal_tpu.serve.bucket.BucketSpec` (abstract
+  shapes + static VisData metadata),
+- the numerics ``config_fingerprint`` (solver knobs + precision),
+- the batch width (the executable is specialized on B),
+- the jax AND jaxlib versions plus the backend platform — an executable
+  compiled by yesterday's jaxlib, or for a different backend, must
+  never load.
+
+File format: one JSON header line (version fields, checked BEFORE any
+unpickling) followed by the pickled ``(payload, in_tree, out_tree)``
+triple.  Writes are atomic (tmp + ``os.replace``), so a concurrently
+reading worker sees either nothing or a whole artifact; a corrupted or
+header-mismatched file is treated as a miss (clean recompile) and
+counted, never a crash.
+
+Security note: artifacts embed pickled pytree definitions, so the
+store directory must be trusted to the same degree as the code tree
+itself (same trust level as the persistent XLA compilation cache).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+from typing import Any, Callable, Optional
+
+AOT_STORE_SCHEMA_VERSION = 1
+
+_MAGIC = "sagecal-aot-artifact"
+
+_runtime_ready = False
+
+
+def _ensure_cpu_runtime() -> None:
+    """Register the runtime libraries a deserialized executable calls
+    into.  Compiling registers them as a side effect (jaxlib's LAPACK
+    shim fills its scipy function-pointer table inside
+    ``prepare_lapack_call`` at lowering time), but a worker that LOADS
+    every bucket from the store never lowers anything — and the first
+    eigh/qr custom call then jumps through a null pointer (hard
+    SIGSEGV, not a catchable exception).  ``_lapack.initialize()`` is
+    idempotent, so call it before the first deserialize."""
+    global _runtime_ready
+    if _runtime_ready:
+        return
+    try:
+        from jaxlib.cpu import _lapack
+
+        _lapack.initialize()
+    except Exception:
+        # non-CPU wheels may lack the shim; loaded executables for
+        # those backends don't use it
+        pass
+    _runtime_ready = True
+
+
+def _version_fields() -> dict:
+    import jax
+    import jaxlib
+
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "unknown"
+    return {
+        "schema": AOT_STORE_SCHEMA_VERSION,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": backend,
+    }
+
+
+def artifact_key(bucket, fingerprint: str, batch: int) -> str:
+    """Stable digest naming one (bucket, numerics, batch-width,
+    toolchain) executable."""
+    doc = json.dumps(
+        {
+            "bucket": list(bucket),
+            "fingerprint": fingerprint,
+            "batch": int(batch),
+            **_version_fields(),
+        },
+        sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(doc.encode("utf-8")).hexdigest()[:32]
+
+
+class AOTArtifactStore:
+    """One directory of ``aot-<key>.bin`` artifacts shared by a fleet.
+
+    ``load`` returns the callable compiled executable or ``None`` (any
+    failure — absent, torn, version-mismatched, unloadable — is a miss;
+    the caller recompiles).  ``save`` is best-effort: a full disk or a
+    lost race never fails the solve that produced the executable."""
+
+    def __init__(self, root: str):
+        self.root = root
+        #: human-readable detail of the most recent load/save failure
+        #: (surfaced in worker logs; failures are also counted in the
+        #: registry as serve_executable_cache_aot_errors_total)
+        self.last_error: Optional[str] = None
+
+    def path_for(self, key: str) -> str:
+        return os.path.join(self.root, f"aot-{key}.bin")
+
+    # -- read side ----------------------------------------------------
+
+    def load(self, bucket, fingerprint: str, batch: int
+             ) -> Optional[Callable]:
+        key = artifact_key(bucket, fingerprint, batch)
+        path = self.path_for(key)
+        try:
+            with open(path, "rb") as f:
+                header = json.loads(f.readline().decode("utf-8"))
+                if header.get("magic") != _MAGIC:
+                    raise ValueError("bad magic")
+                mine = _version_fields()
+                for k, v in mine.items():
+                    if header.get(k) != v:
+                        raise ValueError(
+                            f"version mismatch: {k}={header.get(k)!r} "
+                            f"(this process: {v!r})")
+                payload, in_tree, out_tree = pickle.load(f)
+        except FileNotFoundError:
+            self._count("aot_misses", bucket)
+            return None
+        except Exception as e:
+            # torn, corrupted, or stale-toolchain artifact: a clean
+            # recompile (which then overwrites it) is the recovery
+            self._count("aot_errors", bucket)
+            self.last_error = f"{path}: {e!r}"
+            return None
+        try:
+            from jax.experimental import serialize_executable as se
+
+            _ensure_cpu_runtime()
+            loaded = se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception as e:
+            self._count("aot_errors", bucket)
+            self.last_error = f"{path}: {e!r}"
+            return None
+        self._count("aot_hits", bucket)
+        return loaded
+
+    # -- write side ---------------------------------------------------
+
+    def save(self, bucket, fingerprint: str, batch: int,
+             compiled: Any) -> Optional[str]:
+        try:
+            from jax.experimental import serialize_executable as se
+
+            payload, in_tree, out_tree = se.serialize(compiled)
+            key = artifact_key(bucket, fingerprint, batch)
+            path = self.path_for(key)
+            os.makedirs(self.root, exist_ok=True)
+            header = dict(_version_fields(), magic=_MAGIC,
+                          bucket=bucket.short(),
+                          fingerprint=fingerprint[:12], batch=int(batch))
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(json.dumps(header, sort_keys=True).encode("utf-8"))
+                f.write(b"\n")
+                pickle.dump((payload, in_tree, out_tree), f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            self._count("aot_saves", bucket)
+            return path
+        except Exception as e:
+            self.last_error = f"{self.root}: {e!r}"
+            return None
+
+    # -- counters -----------------------------------------------------
+
+    def _count(self, kind: str, bucket) -> None:
+        try:
+            from sagecal_tpu.obs.registry import get_registry
+
+            get_registry().counter_inc(
+                f"serve_executable_cache_{kind}_total",
+                help=f"cross-worker AOT artifact store lookups ({kind})",
+                bucket=bucket.short())
+        except Exception:
+            pass

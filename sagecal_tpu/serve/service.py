@@ -76,6 +76,92 @@ def _merge_sage_config(cfg, req: SolveRequest):
     return scfg, fp
 
 
+class _StreamPool:
+    """Bounded pool of double-buffered prefetch streams.
+
+    One stream per (tenant, dataset, tilesz, column) request sequence,
+    exactly as before — but opened lazily on first touch and capped at
+    ``cap`` concurrently-open :class:`TilePrefetcher` instances
+    (``cap <= 0`` = unbounded, the legacy behavior).  Above the cap the
+    least-recently-used stream is CLOSED (its reader threads reaped and
+    its HDF5 handle released) and transparently reopened from its
+    remaining tiles when next touched; each close-for-capacity is
+    counted in ``serve_prefetch_evictions_total``.  Without a cap a
+    fleet worker claiming requests across many tenants×datasets holds
+    one open prefetcher (threads + file handles + depth×tile buffers)
+    per stream simultaneously — unbounded fleet-wide."""
+
+    def __init__(self, cap: int):
+        self.cap = int(cap)
+        self.evictions = 0
+        self._specs: Dict[tuple, dict] = {}
+        self._open_streams: "collections.OrderedDict[tuple, dict]" = \
+            collections.OrderedDict()
+
+    def register(self, skey: tuple, t0s: List[int], dtype) -> None:
+        from sagecal_tpu.io.dataset import VisDataset
+
+        _, dpath, _tilesz, _column = skey
+        ds = VisDataset(dpath, "r")
+        meta = ds.meta
+        ds.close()
+        self._specs[skey] = {"t0s": list(t0s), "pos": 0, "meta": meta,
+                             "dtype": dtype}
+
+    def meta(self, skey: tuple):
+        return self._specs[skey]["meta"]
+
+    def next_tile(self, skey: tuple):
+        """The next (t0, (data,)) of this stream, opening/reopening its
+        prefetcher as needed and closing it when the stream drains."""
+        st = self._open_streams.get(skey)
+        if st is None:
+            st = self._open(skey)
+        else:
+            self._open_streams.move_to_end(skey)
+        spec = self._specs[skey]
+        got = next(st["it"])
+        spec["pos"] += 1
+        if spec["pos"] >= len(spec["t0s"]):
+            # drained: the iterator just consumed its sentinel; reap
+            # the reader threads now instead of at run teardown
+            st["pf"].close()
+            self._open_streams.pop(skey, None)
+        return got
+
+    def _open(self, skey: tuple) -> dict:
+        from sagecal_tpu.io.dataset import TilePrefetcher
+
+        while self.cap > 0 and len(self._open_streams) >= self.cap:
+            _vkey, vst = self._open_streams.popitem(last=False)
+            vst["pf"].close()
+            self.evictions += 1
+            try:
+                from sagecal_tpu.obs.registry import get_registry
+
+                get_registry().counter_inc(
+                    "serve_prefetch_evictions_total",
+                    help="prefetch streams closed for capacity "
+                         "(reopened from remaining tiles on next touch)")
+            except Exception:
+                pass
+        spec = self._specs[skey]
+        _, dpath, tilesz, column = skey
+        pf = TilePrefetcher(
+            dpath, spec["t0s"][spec["pos"]:],
+            [dict(average_channels=True, dtype=spec["dtype"],
+                  column=column)],
+            tilesz, depth=2)
+        st = {"pf": pf, "it": iter(pf.__enter__())}
+        self._open_streams[skey] = st
+        return st
+
+    def close(self) -> None:
+        for st in self._open_streams.values():
+            st["pf"].close()
+        self._open_streams.clear()
+
+
 class _Entry:
     """One loaded, solve-ready request."""
 
@@ -105,11 +191,11 @@ class CalibrationService:
     percentiles, executable-cache stats) used by the CLI, the bench and
     the tests."""
 
-    def __init__(self, cfg, log=print, device=None):
+    def __init__(self, cfg, log=print, device=None, aot_store=None):
         self.cfg = cfg
         self.log = log
         self.device = device
-        self.cache = ExecutableCache()
+        self.cache = ExecutableCache(store=aot_store)
         self._sky_cache: Dict[tuple, tuple] = {}
         self._results: List[Dict[str, Any]] = []
         self._latencies: List[float] = []
@@ -189,17 +275,21 @@ class CalibrationService:
         keys = np.stack([entries[i].key for i in idx])
         scfg = entries[0].scfg
 
-        fn, cache_hit = self.cache.get_with_status(bucket, fingerprint)
         args = (data_b, cdata_b, vis.real, vis.imag, coh.real, coh.imag,
                 p0, scfg, keys)
         if self.device is not None:
             args = jax.device_put(args, self.device)
         pack_s = time.time() - t_pack
-        # compile time shows up inside the first call of the wrapper;
-        # split it out of execute via the perf-stats delta so the
-        # lifecycle's compile|cache_hit span is honest
-        compile_before = self._compile_seconds(fn)
+        # compile time shows up either inside get_with_status (AOT
+        # store path) or inside the first call of the lazy wrapper;
+        # both land between `tic` and the host sync, and the perf-stats
+        # delta splits compile out of execute so the lifecycle's
+        # compile|cache_hit span is honest either way
+        name = self.cache.entry_name(bucket, fingerprint)
+        compile_before = self._compile_seconds_by_name(name)
         tic = time.time()
+        fn, cache_hit = self.cache.get_with_status(
+            bucket, fingerprint, example_args=args)
         out = fn(*args)
         # materialize on host before unpacking lanes (one sync)
         p_host = np.asarray(out.p)
@@ -209,7 +299,7 @@ class CalibrationService:
         nu_host = np.asarray(out.mean_nu)
         solve_s = time.time() - tic
         compile_s = 0.0 if cache_hit else max(
-            self._compile_seconds(fn) - compile_before, 0.0)
+            self._compile_seconds_by_name(name) - compile_before, 0.0)
         timing = {
             "t_pack": t_pack, "pack_s": pack_s, "t_exec": tic,
             "solve_s": solve_s, "cache_hit": cache_hit,
@@ -221,7 +311,14 @@ class CalibrationService:
                       batch=len(idx), padded=padded_flush,
                       seconds=solve_s,
                       cache=self.cache.stats())
-        for lane in range(k):
+        # unpack over the FULL batch width with an explicit validity
+        # guard: replication-padded lanes (valid[lane] is False) carry
+        # a copy of some real request's data, so their solve outputs —
+        # and in particular their quality structures — must never reach
+        # _finish_request, or a padded tail lane could fire a spurious
+        # quality_degraded / solver_diverged verdict for a request that
+        # already has its real verdict from its own lane.
+        for lane in range(len(idx)):
             if not valid[lane]:
                 continue
             self._finish_request(
@@ -234,13 +331,12 @@ class CalibrationService:
                 elog, timing)
 
     @staticmethod
-    def _compile_seconds(fn) -> float:
-        """Cumulative compile seconds attributed to an instrumented-jit
-        wrapper (0.0 when perf stats are unavailable)."""
+    def _compile_seconds_by_name(name: str) -> float:
+        """Cumulative compile seconds attributed to a named executable
+        entry (0.0 when perf stats are unavailable)."""
         try:
             from sagecal_tpu.obs.perf import perf_stats
 
-            name = getattr(fn, "name", None)
             if not name:
                 return 0.0
             return float(perf_stats().get(name, {}).get(
@@ -409,7 +505,6 @@ class CalibrationService:
         from sagecal_tpu.elastic.checkpoint import (
             CheckpointManager, config_fingerprint,
         )
-        from sagecal_tpu.io.dataset import TilePrefetcher, VisDataset
         from sagecal_tpu.obs.quality import DivergenceAbort
         from sagecal_tpu.obs.registry import get_registry
 
@@ -488,24 +583,15 @@ class CalibrationService:
                           help="requests waiting in this tenant's queue")
 
         dtype = np.float64 if cfg.use_f64 else np.float32
-        streams: Dict[tuple, dict] = {}
+        stream_t0s: Dict[tuple, List[int]] = {}
         for t in tenants:
             for r in queues[t]:
                 skey = (t, os.path.abspath(r.dataset), r.tilesz,
                         r.in_column)
-                streams.setdefault(skey, {"t0s": [], "reqs": []})
-                streams[skey]["t0s"].append(r.t0)
-                streams[skey]["reqs"].append(r)
-        for skey, s in streams.items():
-            _, dpath, tilesz, column = skey
-            ds = VisDataset(dpath, "r")
-            s["meta"] = ds.meta
-            ds.close()
-            s["pf"] = TilePrefetcher(
-                dpath, s["t0s"],
-                [dict(average_channels=True, dtype=dtype, column=column)],
-                tilesz, depth=2)
-            s["it"] = iter(s["pf"].__enter__())
+                stream_t0s.setdefault(skey, []).append(r.t0)
+        pool = _StreamPool(getattr(cfg, "max_streams", 0))
+        for skey, t0s in stream_t0s.items():
+            pool.register(skey, t0s, dtype)
 
         pending: Dict[tuple, List[_Entry]] = collections.defaultdict(list)
         served = 0
@@ -554,14 +640,14 @@ class CalibrationService:
                                   tenant=t)
                     skey = (t, os.path.abspath(req.dataset),
                             req.tilesz, req.in_column)
-                    t0, (data,) = next(streams[skey]["it"])
+                    t0, (data,) = pool.next_tile(skey)
                     if t0 != req.t0:
                         raise RuntimeError(
                             f"prefetch order mismatch for "
                             f"{req.request_id}: got tile {t0}, "
                             f"expected {req.t0}")
                     entry, fp = self._load_entry(
-                        req, data, streams[skey]["meta"])
+                        req, data, pool.meta(skey))
                     entry.enqueued_at = enqueued_at.get(
                         req.request_id, t_start)
                     entry.started_at = t_pop
@@ -574,11 +660,10 @@ class CalibrationService:
                 dispatch(bkey, padded_flush=True)
         finally:
             # streams drain exactly when their queues do, so on the
-            # success path every worker already consumed its sentinel;
-            # on an error path close() reaps them (satellite of the
-            # crash-flusher contract: no leaked reader threads)
-            for s in streams.values():
-                s["pf"].close()
+            # success path every stream already closed on its sentinel;
+            # on an error path pool.close() reaps the still-open ones
+            # (crash-flusher contract: no leaked reader threads)
+            pool.close()
             for mgr in ckmgrs.values():
                 mgr.flush()
                 mgr.close()
@@ -607,6 +692,7 @@ class CalibrationService:
             "wall_s": wall,
             "solves_per_sec": served / wall if wall > 0 else 0.0,
             "p50_latency_s": p50,
+            "prefetch_evictions": pool.evictions,
             "results": self._results,
         }
         if self._slo is not None and self._slo.enabled:

@@ -1,0 +1,654 @@
+"""Fleet subsystem tests (sagecal_tpu/fleet/ + serve/aot_store.py):
+
+- the filesystem lease queue: atomic claim (exactly one winner),
+  renewal, expiry + steal (LeaseLost for the previous holder), done
+  markers, EDF + bucket-affinity claim ordering;
+- admission control: accept/degrade/shed per SLO burn, budget clamps,
+  shed manifests excluded from burn samples (no shed latch);
+- the cross-worker AOT artifact store: save/load round trip, a second
+  cache over a warm store records zero compiles (counter-pinned), and
+  corrupted or version-mismatched artifacts fall back to a clean
+  recompile instead of crashing;
+- coordinator plumbing (bucket hints, worker argv, queue seeding);
+- slow two-worker subprocess e2e: warm-store zero compiles fleet-wide,
+  SIGKILL'd-worker lease requeue with no duplicate/torn manifests, and
+  overload shedding per tenant SLOSpec.
+"""
+
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.fleet
+
+
+# ---------------------------------------------------------------------------
+# queue: lease protocol
+# ---------------------------------------------------------------------------
+
+
+def _item(rid, tenant="t0", deadline=math.inf, hint="", enq=100.0):
+    from sagecal_tpu.fleet.queue import WorkItem
+
+    return WorkItem(request_id=rid, tenant=tenant,
+                    request={"request_id": rid, "tenant": tenant},
+                    deadline=deadline, bucket_hint=hint,
+                    enqueued_at=enq)
+
+
+class TestWorkItem:
+    def test_doc_round_trip_preserves_inf_deadline(self):
+        from sagecal_tpu.fleet.queue import WorkItem
+
+        it = _item("r1", deadline=math.inf, hint="N7xT2xF1")
+        doc = it.to_doc()
+        assert doc["deadline"] is None  # JSON has no inf
+        back = WorkItem.from_doc(json.loads(json.dumps(doc)))
+        assert back == it
+
+    def test_doc_round_trip_finite_deadline(self):
+        from sagecal_tpu.fleet.queue import WorkItem
+
+        it = _item("r2", deadline=123.5)
+        assert WorkItem.from_doc(it.to_doc()).deadline == 123.5
+
+
+class TestLeaseQueue:
+    def test_claim_is_exclusive(self, tmp_path):
+        from sagecal_tpu.fleet.queue import LeaseQueue
+
+        qa = LeaseQueue(str(tmp_path), worker="wa", ttl_s=30.0)
+        qb = LeaseQueue(str(tmp_path), worker="wb", ttl_s=30.0)
+        qa.put(_item("r1"))
+        assert qa.claim("r1", now=1000.0)
+        assert not qb.claim("r1", now=1000.0)
+        assert qa.read_lease("r1")["worker"] == "wa"
+
+    def test_claim_refuses_done(self, tmp_path):
+        from sagecal_tpu.fleet.queue import LeaseQueue
+
+        q = LeaseQueue(str(tmp_path), worker="wa", ttl_s=30.0)
+        q.put(_item("r1"))
+        assert q.claim("r1", now=1000.0)
+        q.complete("r1", verdict="ok")
+        assert not q.claim("r1", now=1001.0)
+        assert q.all_done()
+
+    def test_expired_lease_is_stolen_and_renewal_raises(self, tmp_path):
+        from sagecal_tpu.fleet.queue import LeaseLost, LeaseQueue
+
+        qa = LeaseQueue(str(tmp_path), worker="wa", ttl_s=10.0)
+        qb = LeaseQueue(str(tmp_path), worker="wb", ttl_s=10.0)
+        qa.put(_item("r1"))
+        assert qa.claim("r1", now=1000.0)  # expires at 1010
+        assert not qb.claim("r1", now=1005.0)  # still live
+        assert qb.claim("r1", now=1011.0)  # expired: stolen
+        assert qb.read_lease("r1")["worker"] == "wb"
+        with pytest.raises(LeaseLost):
+            qa.renew("r1", now=1012.0)
+
+    def test_renew_extends_expiry(self, tmp_path):
+        from sagecal_tpu.fleet.queue import LeaseQueue
+
+        q = LeaseQueue(str(tmp_path), worker="wa", ttl_s=10.0)
+        q.put(_item("r1"))
+        assert q.claim("r1", now=1000.0)
+        assert q.renew("r1", now=1008.0) == 1018.0
+        assert q.read_lease("r1")["expires_at"] == 1018.0
+
+    def test_stats_and_pending_track_lease_states(self, tmp_path):
+        from sagecal_tpu.fleet.queue import LeaseQueue
+
+        q = LeaseQueue(str(tmp_path), worker="wa", ttl_s=10.0)
+        for rid in ("r1", "r2", "r3"):
+            q.put(_item(rid))
+        q.claim("r1", now=1000.0)
+        q.claim("r2", now=1000.0)
+        q.complete("r2", verdict="ok")
+        st = q.stats(now=1005.0)
+        assert st == {"items": 3, "done": 1, "leased": 1,
+                      "expired_leases": 0}
+        # r1's lease expires: it becomes pending again
+        st = q.stats(now=1011.0)
+        assert st["expired_leases"] == 1
+        assert {i.request_id for i in q.pending(now=1011.0)} == \
+            {"r1", "r3"}
+
+    def test_failure_markers_accumulate(self, tmp_path):
+        from sagecal_tpu.fleet.queue import LeaseQueue
+
+        qa = LeaseQueue(str(tmp_path), worker="wa")
+        qb = LeaseQueue(str(tmp_path), worker="wb")
+        assert qa.record_failure("r1", "boom") == 1
+        assert qb.record_failure("r1", "boom again") == 2
+        assert qa.failure_count("r1") == 2
+        assert qa.failure_count("r2") == 0
+
+
+class TestSelectOrdering:
+    def test_edf_orders_by_deadline(self, tmp_path):
+        from sagecal_tpu.fleet.queue import LeaseQueue
+
+        q = LeaseQueue(str(tmp_path), worker="wa")
+        q.put(_item("late", deadline=5000.0))
+        q.put(_item("soon", deadline=1000.0))
+        q.put(_item("never"))  # inf deadline sorts last
+        order = [i.request_id for i in q.select(limit=0, now=0.0)]
+        assert order == ["soon", "late", "never"]
+
+    def test_affinity_wins_within_deadline_window(self, tmp_path):
+        from sagecal_tpu.fleet.queue import LeaseQueue
+
+        q = LeaseQueue(str(tmp_path), worker="wa")
+        # same 10 s deadline window: the held bucket goes first
+        q.put(_item("other", deadline=1001.0, hint="N8xT2xF1"))
+        q.put(_item("mine", deadline=1004.0, hint="N7xT2xF1"))
+        order = [i.request_id for i in q.select(
+            affinity={"N7xT2xF1"}, limit=0, now=0.0,
+            affinity_window_s=10.0)]
+        assert order == ["mine", "other"]
+
+    def test_affinity_never_jumps_an_earlier_window(self, tmp_path):
+        from sagecal_tpu.fleet.queue import LeaseQueue
+
+        q = LeaseQueue(str(tmp_path), worker="wa")
+        q.put(_item("urgent", deadline=1000.0, hint="N8xT2xF1"))
+        q.put(_item("mine", deadline=1100.0, hint="N7xT2xF1"))
+        order = [i.request_id for i in q.select(
+            affinity={"N7xT2xF1"}, limit=0, now=0.0,
+            affinity_window_s=10.0)]
+        assert order == ["urgent", "mine"]
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+def _spec(tenant="t0", deadline_s=1.0, availability=0.9,
+          shed_burn=2.0):
+    from sagecal_tpu.obs.slo import SLOSpec
+
+    return SLOSpec(tenant=tenant, deadline_s=deadline_s,
+                   availability=availability,
+                   windows_s=(60.0, 300.0), shed_burn=shed_burn)
+
+
+def _manifest(rid, tenant="t0", latency=0.1, verdict="ok", ts=None):
+    ts = time.time() if ts is None else ts
+    return {"request_id": rid, "tenant": tenant, "verdict": verdict,
+            "latency_s": latency, "completed_at": ts}
+
+
+class TestAdmission:
+    def test_accept_without_specs_or_when_off(self):
+        from sagecal_tpu.fleet.admission import AdmissionController
+
+        ctl = AdmissionController({}, policy="shed")
+        assert ctl.decide("t0")[0] == "accept"
+        ctl = AdmissionController({"t0": _spec()}, policy="off")
+        ctl.ingest_results(
+            [_manifest(f"r{i}", latency=9.0) for i in range(10)])
+        assert ctl.decide("t0")[0] == "accept"
+
+    def test_overload_sheds_or_degrades_per_policy(self):
+        from sagecal_tpu.fleet.admission import AdmissionController
+
+        blown = [_manifest(f"r{i}", latency=9.0) for i in range(10)]
+        shed = AdmissionController({"t0": _spec()}, policy="shed")
+        shed.ingest_results(blown)
+        decision, detail = shed.decide("t0")
+        assert decision == "shed"
+        assert detail["shed_burn"] == 2.0
+        deg = AdmissionController({"t0": _spec()}, policy="degrade")
+        deg.ingest_results(blown)
+        assert deg.decide("t0")[0] == "degrade"
+
+    def test_unknown_tenant_is_accepted_under_overload(self):
+        from sagecal_tpu.fleet.admission import AdmissionController
+
+        ctl = AdmissionController({"t0": _spec()}, policy="shed")
+        ctl.ingest_results(
+            [_manifest(f"r{i}", latency=9.0) for i in range(10)])
+        assert ctl.decide("t1")[0] == "accept"
+
+    def test_degrade_clamps_but_never_raises_budgets(self):
+        from sagecal_tpu.fleet.admission import AdmissionController
+
+        ctl = AdmissionController({}, degrade_emiter=1,
+                                  degrade_lbfgs=4)
+        out = ctl.degrade_request({"max_emiter": 3, "max_lbfgs": 10})
+        assert (out["max_emiter"], out["max_lbfgs"]) == (1, 4)
+        out = ctl.degrade_request({"max_emiter": 1, "max_lbfgs": 2})
+        assert (out["max_emiter"], out["max_lbfgs"]) == (1, 2)
+        out = ctl.degrade_request({})
+        assert (out["max_emiter"], out["max_lbfgs"]) == (1, 4)
+
+    def test_shed_manifests_do_not_latch_the_trigger(self, tmp_path):
+        """Sheds are excluded from burn samples: after the blown
+        requests age past recovery (good solves dominate the window),
+        admission resumes even though many sheds were written."""
+        from sagecal_tpu.fleet.admission import AdmissionController
+        from sagecal_tpu.fleet.queue import WorkItem
+
+        ctl = AdmissionController({"t0": _spec()}, policy="shed")
+        now = time.time()
+        ctl.ingest_results([_manifest("bad", latency=9.0, ts=now)])
+        assert ctl.decide("t0", now=now)[0] == "shed"
+        # the refusals themselves (verdict=shed) must not count as
+        # errors, or the trigger would hold itself high forever
+        for i in range(20):
+            item = WorkItem(request_id=f"s{i}", tenant="t0",
+                            request={}, enqueued_at=now)
+            ctl.shed_result(item, str(tmp_path), {"shed_burn": 2.0})
+        ctl.ingest_results(
+            [_manifest(f"g{i}", latency=0.1, ts=now + 1) for i in
+             range(30)])
+        assert ctl.decide("t0", now=now + 2)[0] == "accept"
+
+    def test_shed_result_writes_definitive_manifest(self, tmp_path):
+        from sagecal_tpu.fleet.admission import (
+            SHED_VERDICT, AdmissionController,
+        )
+        from sagecal_tpu.fleet.queue import WorkItem
+        from sagecal_tpu.serve.request import result_manifest_path
+
+        ctl = AdmissionController({"t0": _spec()})
+        item = WorkItem(request_id="r9", tenant="t0",
+                        request={"dataset": "d.h5", "t0": 4,
+                                 "tilesz": 2},
+                        enqueued_at=time.time() - 0.5)
+        ctl.shed_result(item, str(tmp_path), {"shed_burn": 2.0})
+        doc = json.load(open(result_manifest_path(str(tmp_path), "r9")))
+        assert doc["verdict"] == SHED_VERDICT
+        assert doc["latency_s"] >= 0.4
+        assert any("slo_overload" in r for r in doc["reasons"])
+
+
+# ---------------------------------------------------------------------------
+# cross-worker AOT artifact store
+# ---------------------------------------------------------------------------
+
+
+def _bucket(n=7):
+    from sagecal_tpu.serve.bucket import BucketSpec
+
+    return BucketSpec(nstations=n, nbase=84, tilesz=2, nchan=1,
+                      nclus=2, nchunk_max=1, dof=8 * n,
+                      dtype="float32", freq0=150e6, deltaf=1e5,
+                      deltat=1.0)
+
+
+def _stub_args(batch=2):
+    """Nine positional arrays shaped like the packed-batch signature
+    (index 6 is ``p0`` — the batch-width probe)."""
+    rng = np.random.default_rng(0)
+    mk = lambda *s: rng.standard_normal(s).astype(np.float32)  # noqa
+    return (mk(batch, 3), mk(batch, 4), mk(batch, 5), mk(batch, 5),
+            mk(batch, 6), mk(batch, 6), mk(batch, 2, 8),
+            mk(batch, 2), mk(batch, 2))
+
+
+def _stub_solver(monkeypatch):
+    """Replace the packed-batch solver with a cheap jit-compatible
+    function (same donate contract) so store-tier tests compile in
+    milliseconds."""
+    import sagecal_tpu.solvers.batched as batched
+
+    def fake_sagefit(a, b, vr, vi, cr, ci, p0, scfg, keys):
+        return p0 * 2.0 + vr.sum() * scfg.sum()
+
+    monkeypatch.setattr(batched, "sagefit_packed_batch", fake_sagefit)
+    return fake_sagefit
+
+
+def _cache_counters():
+    from sagecal_tpu.obs.aggregate import state_counter_total
+    from sagecal_tpu.obs.registry import get_registry
+
+    snap = get_registry().export_state()
+    return {k: state_counter_total(
+        snap, f"serve_executable_cache_{k}_total")
+        for k in ("compiles", "aot_hits", "aot_misses", "aot_errors",
+                  "aot_saves")}
+
+
+class TestAOTStore:
+    def test_artifact_key_separates_buckets_and_batch(self):
+        from sagecal_tpu.serve.aot_store import artifact_key
+
+        k = artifact_key(_bucket(7), "fp", 2)
+        assert k == artifact_key(_bucket(7), "fp", 2)
+        assert k != artifact_key(_bucket(8), "fp", 2)
+        assert k != artifact_key(_bucket(7), "fp2", 2)
+        assert k != artifact_key(_bucket(7), "fp", 3)
+
+    def test_second_cache_loads_with_zero_compiles(self, tmp_path,
+                                                   monkeypatch):
+        """A fresh ExecutableCache over a warm store records an AOT
+        hit and NO compile — pinned on both the plain cache stats and
+        the registry counters (the same evidence the fleet summary
+        aggregates across worker processes)."""
+        from sagecal_tpu.obs.registry import telemetry
+        from sagecal_tpu.serve.aot_store import AOTArtifactStore
+        from sagecal_tpu.serve.cache import ExecutableCache
+
+        _stub_solver(monkeypatch)
+        store = AOTArtifactStore(str(tmp_path / "store"))
+        args = _stub_args()
+        bucket = _bucket()
+        with telemetry():
+            before = _cache_counters()
+            cold = ExecutableCache(store=store)
+            fn1, hit1 = cold.get_with_status(bucket, "fp",
+                                             example_args=args)
+            mid = _cache_counters()
+            assert not hit1
+            assert mid["compiles"] - before["compiles"] == 1
+            assert mid["aot_misses"] - before["aot_misses"] == 1
+            assert mid["aot_saves"] - before["aot_saves"] == 1
+            out1 = np.asarray(fn1(*args))
+            # ... the "new worker joining a warm fleet": a fresh cache
+            warm = ExecutableCache(store=store)
+            fn2, hit2 = warm.get_with_status(bucket, "fp",
+                                             example_args=args)
+            after = _cache_counters()
+            assert hit2  # loaded, not compiled
+            assert after["compiles"] == mid["compiles"]
+            assert after["aot_hits"] - mid["aot_hits"] == 1
+            np.testing.assert_array_equal(out1, np.asarray(fn2(*args)))
+
+    def test_corrupted_artifact_recompiles_cleanly(self, tmp_path,
+                                                   monkeypatch):
+        from sagecal_tpu.obs.registry import telemetry
+        from sagecal_tpu.serve.aot_store import AOTArtifactStore
+        from sagecal_tpu.serve.cache import ExecutableCache
+
+        _stub_solver(monkeypatch)
+        store = AOTArtifactStore(str(tmp_path / "store"))
+        args = _stub_args()
+        ExecutableCache(store=store).get_with_status(
+            _bucket(), "fp", example_args=args)
+        (artifact,) = [f for f in os.listdir(store.root)
+                       if f.startswith("aot-")]
+        with open(os.path.join(store.root, artifact), "r+b") as f:
+            f.seek(0)
+            f.write(b"garbage \x00\x01")
+        with telemetry():
+            before = _cache_counters()
+            fresh = ExecutableCache(store=store)
+            fn, hit = fresh.get_with_status(_bucket(), "fp",
+                                            example_args=args)
+            after = _cache_counters()
+        assert not hit  # clean recompile, no crash
+        assert after["aot_errors"] - before["aot_errors"] == 1
+        assert after["compiles"] - before["compiles"] == 1
+        assert store.last_error is not None
+        assert np.asarray(fn(*args)).shape == args[6].shape
+        # the recompile re-saved a healthy artifact over the bad one
+        assert ExecutableCache(store=store).get_with_status(
+            _bucket(), "fp", example_args=args)[1]
+
+    def test_version_mismatched_artifact_is_refused(self, tmp_path,
+                                                    monkeypatch):
+        from sagecal_tpu.serve.aot_store import AOTArtifactStore
+        from sagecal_tpu.serve.cache import ExecutableCache
+
+        _stub_solver(monkeypatch)
+        store = AOTArtifactStore(str(tmp_path / "store"))
+        args = _stub_args()
+        ExecutableCache(store=store).get_with_status(
+            _bucket(), "fp", example_args=args)
+        (artifact,) = [f for f in os.listdir(store.root)
+                       if f.startswith("aot-")]
+        path = os.path.join(store.root, artifact)
+        with open(path, "rb") as f:
+            header = json.loads(f.readline())
+            rest = f.read()
+        header["jaxlib"] = "0.0.0-yesterday"
+        with open(path, "wb") as f:
+            f.write(json.dumps(header, sort_keys=True).encode())
+            f.write(b"\n")
+            f.write(rest)
+        fn, hit = ExecutableCache(store=store).get_with_status(
+            _bucket(), "fp", example_args=args)
+        assert not hit
+        assert "version mismatch" in (store.last_error or "")
+        assert np.asarray(fn(*args)).shape == args[6].shape
+
+    def test_missing_store_dir_is_a_miss_not_a_crash(self, tmp_path,
+                                                     monkeypatch):
+        from sagecal_tpu.serve.aot_store import AOTArtifactStore
+
+        store = AOTArtifactStore(str(tmp_path / "never-created"))
+        assert store.load(_bucket(), "fp", 2) is None
+
+
+# ---------------------------------------------------------------------------
+# coordinator plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestCoordinatorPlumbing:
+    def test_bucket_hint_shape_key(self):
+        from types import SimpleNamespace
+
+        from sagecal_tpu.fleet.coordinator import bucket_hint_for
+
+        meta = SimpleNamespace(nstations=7, nchan=4)
+        assert bucket_hint_for(meta, 2) == "N7xT2xF1"
+        assert bucket_hint_for(meta, 2, nchan_avg=False) == "N7xT2xF4"
+
+    def test_worker_argv_round_trips_config(self):
+        from sagecal_tpu.apps.fleet import build_parser, \
+            config_from_args
+        from sagecal_tpu.fleet.coordinator import worker_argv
+
+        cfg = config_from_args(build_parser().parse_args(
+            ["--requests", "reqs.json", "--out-dir", "od",
+             "--workers", "3", "--batch", "4", "--f32",
+             "--overload-policy", "shed"]))
+        argv = worker_argv(cfg, 1)
+        assert argv[:3] == [sys.executable, "-m",
+                            "sagecal_tpu.apps.fleet"]
+        for flag, val in (("--role", "worker"), ("--worker-id", "w1"),
+                          ("--batch", "4"),
+                          ("--overload-policy", "shed")):
+            assert val == argv[argv.index(flag) + 1]
+        assert "--f32" in argv
+
+    def test_seed_queue_stamps_scheduling_metadata(self, tmp_path):
+        import h5py
+
+        from sagecal_tpu.fleet.coordinator import seed_queue
+        from sagecal_tpu.fleet.queue import LeaseQueue
+        from sagecal_tpu.io.dataset import simulate_dataset
+        from sagecal_tpu.io.simulate import random_jones
+        from sagecal_tpu.io.skymodel import load_sky
+        from sagecal_tpu.serve.request import SolveRequest
+        from sagecal_tpu.serve.synthetic import _CLUSTER, _SKY
+
+        sky = tmp_path / "sky.txt"
+        sky.write_text(_SKY)
+        (tmp_path / "sky.txt.cluster").write_text(_CLUSTER)
+        dec0 = math.radians(51.0)
+        clusters, _, _ = load_sky(str(sky), str(sky) + ".cluster",
+                                  0.0, dec0, dtype=np.float64)
+        dpath = str(tmp_path / "d.h5")
+        simulate_dataset(dpath, nstations=7, ntime=4, nchan=2,
+                         clusters=clusters,
+                         jones=random_jones(2, 7, seed=3, amp=0.1,
+                                            dtype=np.complex128),
+                         noise_sigma=1e-4, seed=0, dec0=dec0)
+        with h5py.File(dpath, "r+") as f:
+            f.attrs["ra0"] = 0.0
+            f.attrs["dec0"] = dec0
+        reqs = [SolveRequest(request_id=f"r{i}", tenant="t0",
+                             dataset=dpath, sky_model=str(sky),
+                             t0=2 * i, tilesz=2) for i in range(2)]
+        q = LeaseQueue(str(tmp_path / "q"), worker="coord")
+        items = seed_queue(q, reqs, {"t0": _spec(deadline_s=5.0)},
+                           log=lambda *a: None)
+        assert [i.request_id for i in items] == ["r0", "r1"]
+        for it in items:
+            assert it.bucket_hint == "N7xT2xF1"
+            assert math.isfinite(it.deadline)
+            assert it.deadline == pytest.approx(
+                it.enqueued_at + 5.0, abs=1.0)
+            assert not it.large
+        assert len(q.items()) == 2
+        # without a spec the deadline is inf (FIFO tail of EDF)
+        items = seed_queue(q, [SolveRequest(
+            request_id="r9", tenant="t-unknown", dataset=dpath,
+            sky_model=str(sky), t0=0, tilesz=2)], {},
+            log=lambda *a: None)
+        assert math.isinf(items[0].deadline)
+
+
+# ---------------------------------------------------------------------------
+# slow subprocess e2e
+# ---------------------------------------------------------------------------
+
+
+def _run_fleet(args, timeout=420):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "sagecal_tpu.apps.cli", "fleet"] + args,
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+def _read_manifests(out_dir):
+    out = {}
+    for name in os.listdir(out_dir):
+        if name.endswith(".result.json"):
+            doc = json.load(open(os.path.join(out_dir, name)))
+            out[doc["request_id"]] = doc
+    return out
+
+
+def _fleet_counter(out_dir, name):
+    from sagecal_tpu.obs.aggregate import (
+        dedupe_snapshots, merge_states, read_metrics_snapshots,
+        state_counter_total,
+    )
+
+    snaps = dedupe_snapshots(read_metrics_snapshots(out_dir))
+    state = merge_states(d["state"] for d in snaps)
+    return state_counter_total(state, name)
+
+
+@pytest.mark.slow
+class TestFleetE2E:
+    def test_warm_store_worker_compiles_nothing(self, tmp_path):
+        """Cold fleet seeds the store; a second two-worker fleet over
+        the same requests records ZERO compiles fleet-wide (counter-
+        pinned from the workers' metrics snapshots) and reproduces the
+        cold run's solutions bit for bit."""
+        cold_dir = str(tmp_path / "cold")
+        r = _run_fleet(["--synthetic", "4", "--tenants", "1",
+                        "--out-dir", cold_dir, "--workers", "1",
+                        "--batch", "2", "--max-idle", "30", "-j", "1"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        cold = _read_manifests(cold_dir)
+        assert len(cold) == 4
+        assert _fleet_counter(
+            cold_dir, "serve_executable_cache_compiles_total") >= 1
+
+        warm_dir = str(tmp_path / "warm")
+        r = _run_fleet(["--requests",
+                        os.path.join(cold_dir, "requests.json"),
+                        "--out-dir", warm_dir, "--workers", "2",
+                        "--aot-store",
+                        os.path.join(cold_dir, "aot-store"),
+                        "--batch", "2", "--max-idle", "30", "-j", "1"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        warm = _read_manifests(warm_dir)
+        assert set(warm) == set(cold)
+        assert _fleet_counter(
+            warm_dir, "serve_executable_cache_compiles_total") == 0
+        assert _fleet_counter(
+            warm_dir, "serve_executable_cache_aot_hits_total") >= 1
+        for rid, doc in cold.items():
+            assert warm[rid]["verdict"] == doc["verdict"]
+            a = open(os.path.join(cold_dir, f"{rid}.solutions"),
+                     "rb").read()
+            b = open(os.path.join(warm_dir, f"{rid}.solutions"),
+                     "rb").read()
+            assert a == b, f"{rid}: warm solutions differ from cold"
+
+    def test_sigkilled_worker_leases_are_requeued(self, tmp_path):
+        """SIGKILL one of two workers mid-run: its leases expire, the
+        survivor steals them, and the result set is complete with no
+        duplicates or torn manifests."""
+        out_dir = str(tmp_path / "out")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "sagecal_tpu.apps.cli", "fleet",
+             "--synthetic", "6", "--out-dir", out_dir,
+             "--workers", "2", "--batch", "3", "--max-idle", "60",
+             "--lease-ttl", "6", "-j", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        victim = None
+        try:
+            deadline = time.time() + 120
+            lines = []
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    break
+                lines.append(line)
+                if "spawned 2 workers" in line:
+                    pids = [int(p) for p in
+                            line.split("[")[1].split("]")[0]
+                            .split(",")]
+                    victim = pids[-1]
+                    break
+            assert victim is not None, "".join(lines)
+            time.sleep(6.0)  # let the victim claim + start solving
+            os.kill(victim, signal.SIGKILL)
+            out, _ = proc.communicate(timeout=300)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert proc.returncode == 0, out
+        docs = _read_manifests(out_dir)
+        assert len(docs) == 6
+        assert sorted(docs) == [f"req{i:03d}" for i in range(6)]
+        assert all(d.get("verdict") in ("ok", "degraded") for d in
+                   docs.values()), {k: d.get("verdict") for k, d in
+                                    docs.items()}
+
+    def test_overload_sheds_per_slo(self, tmp_path):
+        """Tight tenant deadlines + cold-compile latencies = synthetic
+        overload: the shed policy refuses some requests with definitive
+        manifests while the rest solve normally."""
+        slo = tmp_path / "slo.json"
+        slo.write_text(json.dumps({"slos": [
+            {"tenant": "tenant0", "deadline_s": 0.5,
+             "availability": 0.99, "windows_s": [60, 300],
+             "shed_burn": 2.0},
+            {"tenant": "tenant1", "deadline_s": 0.5,
+             "availability": 0.99, "windows_s": [60, 300],
+             "shed_burn": 2.0}]}))
+        out_dir = str(tmp_path / "out")
+        r = _run_fleet(["--synthetic", "12", "--out-dir", out_dir,
+                        "--workers", "2", "--batch", "3",
+                        "--max-idle", "30", "--slo", str(slo),
+                        "--overload-policy", "shed", "-j", "1"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        docs = _read_manifests(out_dir)
+        assert len(docs) == 12
+        verdicts = [d["verdict"] for d in docs.values()]
+        assert verdicts.count("shed") >= 1
+        assert verdicts.count("ok") >= 1
+        for d in docs.values():
+            if d["verdict"] == "shed":
+                assert any("slo_overload" in x for x in d["reasons"])

@@ -380,3 +380,110 @@ class TestServeCli:
         assert rc == 0
         assert os.path.exists(workdir / "out" / "req000.result.json")
         assert os.path.exists(workdir / "out" / "req001.result.json")
+
+
+class TestPaddedLaneGuard:
+    def test_padding_lane_never_reaches_finish_request(self, workdir):
+        """Regression (fleet PR): a replication-padded tail lane
+        carries a COPY of a real request's solve outputs — its quality
+        structure must never reach ``_finish_request``, or the padded
+        lane would fire a second (possibly spurious) verdict for a
+        request that already has its real one."""
+        from types import SimpleNamespace
+
+        from sagecal_tpu.apps.config import ServeConfig
+        from sagecal_tpu.serve.bucket import bucket_of
+        from sagecal_tpu.serve.service import CalibrationService, _Entry
+        from sagecal_tpu.solvers.sage import SageConfig
+
+        _make_dataset(workdir / "d.h5")
+        ((data, cdata, p0),) = _load_solve_inputs(workdir,
+                                                  [workdir / "d.h5"])
+        scfg = SageConfig(max_emiter=1, max_iter=2, max_lbfgs=4,
+                          solver_mode=1)
+        entry = _Entry(
+            req=SimpleNamespace(request_id="r0", tenant="t0"),
+            data=data, cdata=cdata, p0=p0,
+            key=np.zeros(2, np.uint32), scfg=scfg,
+            meta=None, nclus=2, nchunk_max=1)
+        svc = CalibrationService(
+            ServeConfig(out_dir=str(workdir / "out"), batch=2),
+            log=lambda *a: None)
+
+        batch = 2
+
+        def fake_solve(*args):
+            return SimpleNamespace(
+                p=np.zeros((batch,) + p0.shape, p0.dtype),
+                res_0=np.full(batch, 1.0), res_1=np.full(batch, 0.5),
+                diverged=np.zeros(batch, bool),
+                mean_nu=np.zeros(batch),
+                quality={"chi2": np.arange(batch, dtype=float)})
+
+        svc.cache.get_with_status = \
+            lambda *a, **k: (fake_solve, True)
+        finished = []
+        svc._finish_request = lambda entry, bucket, lane, *a: \
+            finished.append(lane)
+        svc._dispatch(bucket_of(data, cdata, p0), "fp", [entry],
+                      batch, None, padded_flush=True)
+        # ONE real request in a 2-lane batch: lane 1 is padding and
+        # must be dropped before any verdict/metric side effects
+        assert finished == [0]
+
+
+class TestStreamPoolCap:
+    def test_lru_eviction_is_counted_and_transparent(self, workdir):
+        """Two streams under a cap of one open prefetcher: touching
+        them alternately closes the LRU stream each time (counted in
+        ``serve_prefetch_evictions_total``), and every reopened stream
+        resumes from its remaining tiles — same tile sequence as the
+        unbounded pool."""
+        from sagecal_tpu.io import dataset as dsmod
+        from sagecal_tpu.obs.aggregate import state_counter_total
+        from sagecal_tpu.obs.registry import get_registry, telemetry
+        from sagecal_tpu.serve.service import _StreamPool
+
+        for i in range(2):
+            _make_dataset(workdir / f"d{i}.h5", seed=i)
+        keys = [("t0", str(workdir / "d0.h5"), 2, "vis"),
+                ("t1", str(workdir / "d1.h5"), 2, "vis")]
+        before = list(dsmod._ACTIVE_PREFETCHERS)
+        pool = _StreamPool(cap=1)
+        for k in keys:
+            pool.register(k, [0, 2], np.float64)
+        with telemetry():
+            c0 = state_counter_total(
+                get_registry().export_state(),
+                "serve_prefetch_evictions_total")
+            seen = []
+            for k in (keys[0], keys[1], keys[0], keys[1]):
+                t0, (tile,) = pool.next_tile(k)
+                seen.append((k[0], t0))
+                assert len(pool._open_streams) <= 1
+            c1 = state_counter_total(
+                get_registry().export_state(),
+                "serve_prefetch_evictions_total")
+        # touches 2 and 3 each evict the other stream; touch 4 does
+        # NOT — touch 3 drained t0, which self-closes on drain (not an
+        # eviction) and leaves the slot free
+        assert seen == [("t0", 0), ("t1", 0), ("t0", 2), ("t1", 2)]
+        assert pool.evictions == 2
+        assert c1 - c0 == 2
+        pool.close()
+        assert dsmod._ACTIVE_PREFETCHERS == before
+
+    def test_unbounded_pool_never_evicts(self, workdir):
+        from sagecal_tpu.serve.service import _StreamPool
+
+        for i in range(2):
+            _make_dataset(workdir / f"d{i}.h5", seed=i)
+        pool = _StreamPool(cap=0)
+        keys = [("t0", str(workdir / "d0.h5"), 2, "vis"),
+                ("t1", str(workdir / "d1.h5"), 2, "vis")]
+        for k in keys:
+            pool.register(k, [0, 2], np.float64)
+        for k in (keys[0], keys[1], keys[0], keys[1]):
+            pool.next_tile(k)
+        assert pool.evictions == 0
+        pool.close()

@@ -99,9 +99,10 @@ if probe; then
 fi
 echo "=== bf16-coherency fused bench"
 if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
-echo "=== telemetry+quality+trace+serve_obs test pass (CPU, marker-driven)"
-JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 900 \
-  python -m pytest tests/ -q -m "telemetry or quality or trace or serve_obs" \
+echo "=== telemetry+quality+trace+serve_obs+fleet+stream test pass (CPU, marker-driven)"
+JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 1200 \
+  python -m pytest tests/ -q \
+  -m "telemetry or quality or trace or serve_obs or fleet or stream" \
   -p no:cacheprovider | tail -3
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then echo "telemetry test pass FAILED rc=$rc"; exit 1; fi
@@ -282,3 +283,45 @@ print('spatial smoke ok: k_aic=%d k_mdl=%d fista fit %.2e nnz=%d'
       % (s['k_aic'], s['k_mdl'], s['fista_fit_rel'], s['fista_nnz']))" \
   || { echo "spatial smoke validate FAILED"; exit 1; }
 rm -rf "$SPDIR"
+rm -rf "$SPDIR"
+echo "=== two-worker fleet smoke (CPU, kill one worker mid-run)"
+# the fleet lease protocol under real fire: 6 mixed-shape requests into
+# the shared queue, 2 subprocess workers, one SIGKILLed mid-run — its
+# leases must expire, the survivor must steal and re-solve them, and
+# the result set must be complete with no duplicate and no torn
+# manifest (atomic tmp+rename writes)
+FLDIR=$(mktemp -d)
+JAX_PLATFORMS=cpu timeout 600 python - "$FLDIR" <<'PY'
+import json, glob, os, re, signal, subprocess, sys, time
+out = sys.argv[1]
+proc = subprocess.Popen(
+    [sys.executable, "-m", "sagecal_tpu.apps.fleet",
+     "--synthetic", "6", "--out-dir", out, "--workers", "2",
+     "--batch", "2", "-e", "1", "-g", "2", "-l", "4", "-j", "1",
+     "--lease-ttl", "4", "--max-idle", "20", "--f32"],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    env=dict(os.environ, JAX_PLATFORMS="cpu"))
+victim, lines = None, []
+for line in proc.stdout:
+    lines.append(line)
+    m = re.search(r"pids \[(\d+), (\d+)\]", line)
+    if m and victim is None:
+        victim = int(m.group(2))
+        time.sleep(6)  # let it claim leases before the kill
+        os.kill(victim, signal.SIGKILL)
+        print(f"fleet smoke: SIGKILLed worker pid {victim}")
+rc = proc.wait()
+sys.stdout.writelines(lines[-6:])
+assert victim is not None, "never saw the worker pids line"
+assert rc == 0, f"coordinator exited {rc}"
+res = sorted(glob.glob(os.path.join(out, "*.result.json")))
+docs = [json.load(open(f)) for f in res]  # torn JSON would raise here
+ids = [d["request_id"] for d in docs]
+assert sorted(ids) == [f"req{i:03d}" for i in range(6)], ids
+assert len(set(ids)) == 6, f"duplicate manifests: {ids}"
+assert all(d["verdict"] in ("ok", "degraded") for d in docs), \
+    [(d["request_id"], d["verdict"]) for d in docs]
+print("fleet smoke ok: 6/6 unique manifests complete after the kill")
+PY
+[ $? = 0 ] || { echo "fleet kill smoke FAILED"; exit 1; }
+rm -rf "$FLDIR"
