@@ -18,8 +18,22 @@ repo-wide gate:
   JL001 traced-value Python control flow, JL002 host-sync calls,
   JL003 recompile hazards (undeclared static args), JL004 64-bit dtype
   policy violations, JL005 data-dependent shapes in jit, JL006
-  collectives outside the parallel layer, and the report-only JL900
-  dead-import sweep.
+  collectives outside the parallel layer, JL007 undonated carry
+  buffers, the fleet-era rules — JL008 non-atomic writes to protocol
+  state (manifests/leases/queues/checkpoints must go through
+  tmp+rename or exclusive link publish), JL009 ``pickle.load`` without
+  a version-header gate (the serve/aot_store.py pattern is mandatory),
+  JL010 raw ``time.time()`` inside lease/deadline logic instead of an
+  injectable clock, JL011 use of a donated buffer after the jit call
+  that consumed it — and the report-only JL900 dead-import sweep.
+- :mod:`sagecal_tpu.analysis.fsmodel` +
+  :mod:`sagecal_tpu.analysis.protocol_check` go beyond linting: a
+  deterministic simulated filesystem (exact atomicity semantics,
+  crash = loss of unstaged state) and an explicit-state model checker
+  that drives the REAL fleet lease queue and stream owner-lease code
+  through every interleaving of 2-3 logical workers with crash
+  injection at each fs-op boundary, asserting the protocol invariants
+  at every reachable state.  Run it as ``sagecal-tpu diag protocol``.
 - :mod:`sagecal_tpu.analysis.engine` runs the rules, applies per-line
   ``# jaxlint: disable=RULE`` suppression pragmas, and formats
   text/JSON reports.

@@ -53,15 +53,38 @@ def load_baseline(path: str) -> Counter:
     return out
 
 
+def _existing_whys(path: str) -> dict:
+    """key -> "why" justification from the committed baseline, so an
+    --update-baseline rewrite never drops the reasoning attached to a
+    deliberate finding (e.g. the stream solutions append-chain)."""
+    if not path or not os.path.isfile(path):
+        return {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    out = {}
+    for rec in data.get("findings", []):
+        if rec.get("why"):
+            key = (rec["rule"], rec["path"], rec.get("symbol", ""),
+                   rec["message"])
+            out[key] = rec["why"]
+    return out
+
+
 def save_baseline(path: str, findings: Iterable[Finding]) -> None:
     # report-only findings are recorded too (see module docstring);
     # partition() still never gates them
+    whys = _existing_whys(path)
     counts: Counter = collections.Counter(f.key() for f in findings)
-    recs = [
-        {"rule": k[0], "path": k[1], "symbol": k[2], "message": k[3],
-         "count": n}
-        for k, n in sorted(counts.items())
-    ]
+    recs = []
+    for k, n in sorted(counts.items()):
+        rec = {"rule": k[0], "path": k[1], "symbol": k[2],
+               "message": k[3], "count": n}
+        if k in whys:
+            rec["why"] = whys[k]
+        recs.append(rec)
     with open(path, "w", encoding="utf-8") as f:
         json.dump({"version": 1, "findings": recs}, f, indent=2,
                   sort_keys=True)

@@ -17,6 +17,10 @@ from sagecal_tpu.analysis.rules.jl004 import DtypePolicy
 from sagecal_tpu.analysis.rules.jl005 import DataDependentShape
 from sagecal_tpu.analysis.rules.jl006 import StrayCollective
 from sagecal_tpu.analysis.rules.jl007 import UndonatedCarry
+from sagecal_tpu.analysis.rules.jl008 import NonAtomicProtocolWrite
+from sagecal_tpu.analysis.rules.jl009 import UnguardedPickleLoad
+from sagecal_tpu.analysis.rules.jl010 import RawClockInLeaseLogic
+from sagecal_tpu.analysis.rules.jl011 import UseAfterDonation
 from sagecal_tpu.analysis.rules.jl900 import DeadImport
 
 
@@ -29,5 +33,9 @@ def all_rules() -> List[Type[Rule]]:
         DataDependentShape,
         StrayCollective,
         UndonatedCarry,
+        NonAtomicProtocolWrite,
+        UnguardedPickleLoad,
+        RawClockInLeaseLogic,
+        UseAfterDonation,
         DeadImport,
     ]

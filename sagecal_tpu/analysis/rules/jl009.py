@@ -1,0 +1,61 @@
+"""JL009: unguarded ``pickle.load`` of a shared artifact.
+
+Pickled artifacts shared across fleet workers (the AOT executable
+store) outlive any single process, so a loader will eventually meet
+bytes produced by a different jaxlib/python/artifact-format vintage.
+Unpickling those blind either deserializes garbage into the compile
+cache or throws deep inside jax — both far from the real cause.
+
+The repo's mandatory pattern is ``serve/aot_store.py``: a plain-text
+JSON header line carrying a magic tag and the full version fields,
+validated *before* ``pickle.load`` touches the stream, with any
+mismatch treated as a cache miss.  This rule flags
+``pickle.load``/``pickle.loads`` calls whose enclosing function shows
+no sign of that gate (no magic/version check anywhere in the
+function).  The detection is textual over the function body — crude,
+but the point is to force new unpickling sites through a reviewed
+header check rather than to prove the check correct (the protocol
+checker and the aot_store tests do that).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from sagecal_tpu.analysis.engine import Finding, Rule
+from sagecal_tpu.analysis.callgraph import qual_of
+
+_PICKLE_LOADS = {"pickle.load", "pickle.loads",
+                 "cPickle.load", "cPickle.loads"}
+_GATE_TOKENS = ("magic", "version")
+
+
+class UnguardedPickleLoad(Rule):
+    id = "JL009"
+    title = "pickle.load without a version-header gate"
+
+    def check(self, graph) -> Iterator[Finding]:
+        for mi in graph.modules.values():
+            if mi.tree is None:
+                continue
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                q = qual_of(node.func, mi.imports, mi.toplevel, mi.name)
+                if q not in _PICKLE_LOADS:
+                    continue
+                fi = mi.enclosing_function(node)
+                scope = fi.node if fi is not None else mi.tree
+                src = ast.unparse(scope).lower()
+                if any(tok in src for tok in _GATE_TOKENS):
+                    continue
+                yield self.finding(
+                    mi, node,
+                    f"`{q}` without a magic/version header gate — "
+                    f"validate a plain-text header (see "
+                    f"serve/aot_store.py, the mandatory pattern) "
+                    f"before unpickling, and treat any mismatch as a "
+                    f"cache miss",
+                    symbol=fi.qualname if fi else "",
+                )

@@ -1,0 +1,151 @@
+"""JL011: donated buffer read after the jit call that consumed it.
+
+``donate_argnums``/``donate_argnames`` (the JL007 convention) hands the
+input buffer to XLA: after the call returns, the donated array is
+deleted and *any* host-side use of the old reference raises a
+``RuntimeError: Array has been deleted`` — at best.  Under AOT
+executables and async dispatch the failure can surface later and far
+from the cause, so the repo treats post-donation use as a static
+error, not a runtime one.
+
+The rule finds call sites of known donating jit roots, takes every
+donated argument that is a plain local name, and flags loads of that
+name after the call — up to the point the name is rebound (the
+``p0 = fit(p0, ...)`` consuming idiom rebinds on the call line itself
+and is clean).  Calls through aliases the call graph cannot resolve
+are out of scope; the point is to catch the easy-to-write, hard-to-
+debug case of logging or re-solving with a consumed buffer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from sagecal_tpu.analysis.engine import Finding, Rule
+from sagecal_tpu.analysis.callgraph import qual_of
+
+
+def _positional_params(node) -> List[str]:
+    a = node.args
+    return [p.arg for p in
+            list(getattr(a, "posonlyargs", ())) + list(a.args)]
+
+
+def _bound_names(stmt: ast.AST) -> List[str]:
+    """Names (re)bound by an assignment or for statement, unpacking
+    tuple/list/starred targets."""
+    targets = list(getattr(stmt, "targets", ()))
+    single = getattr(stmt, "target", None)
+    if single is not None:
+        targets.append(single)
+    out: List[str] = []
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.append(n.id)
+    return out
+
+
+def _param_names(fnode) -> List[str]:
+    a = fnode.args
+    names = [p.arg for p in
+             list(getattr(a, "posonlyargs", ())) + list(a.args)
+             + list(a.kwonlyargs)]
+    for va in (a.vararg, a.kwarg):
+        if va is not None:
+            names.append(va.arg)
+    return names
+
+
+def _shadowing_spans(scope: ast.AST, name: str):
+    """Line spans of nested lambdas/defs that bind ``name`` as their
+    own parameter: inside them, ``name`` is a fresh binding, not the
+    donated buffer from the enclosing scope."""
+    spans = []
+    for n in ast.walk(scope):
+        if n is scope:
+            continue
+        if isinstance(n, (ast.Lambda, ast.FunctionDef,
+                          ast.AsyncFunctionDef)) \
+                and name in _param_names(n):
+            spans.append((n.lineno, getattr(n, "end_lineno", n.lineno)))
+    return spans
+
+
+def _donated_arg_exprs(call: ast.Call, callee) -> List[ast.AST]:
+    """Caller-side expressions bound to the callee's donated params."""
+    out: List[ast.AST] = []
+    params = _positional_params(callee.node) if isinstance(
+        callee.node, (ast.FunctionDef, ast.AsyncFunctionDef)) else []
+    donated_idx = set(callee.donate_argnums)
+    donated_idx |= {params.index(n) for n in callee.donate_argnames
+                    if n in params}
+    for idx in donated_idx:
+        if idx < len(call.args):
+            out.append(call.args[idx])
+    for kw in call.keywords:
+        if kw.arg in callee.donate_argnames:
+            out.append(kw.value)
+    return out
+
+
+class UseAfterDonation(Rule):
+    id = "JL011"
+    title = "donated buffer used after the jit call"
+
+    def check(self, graph) -> Iterator[Finding]:
+        for mi in graph.modules.values():
+            if mi.tree is None:
+                continue
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                q = qual_of(node.func, mi.imports, mi.toplevel, mi.name)
+                if q is None:
+                    continue
+                fi = mi.enclosing_function(node)
+                scope_q = fi.qualname if fi is not None else ""
+                callee = graph._lookup(q, mi.name, scope_q)
+                if callee is None or not callee.jit_root:
+                    continue
+                if not (callee.donate_argnums or callee.donate_argnames):
+                    continue
+                scope = fi.node if fi is not None else mi.tree
+                end = getattr(node, "end_lineno", node.lineno)
+                for arg in _donated_arg_exprs(node, callee):
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    name = arg.id
+                    spans = _shadowing_spans(scope, name)
+                    if any(lo <= node.lineno <= hi for lo, hi in spans):
+                        # the donated name is a nested lambda/def's own
+                        # parameter (a tracer under jit), not a buffer
+                        # held by this scope
+                        continue
+                    rebinds = [n.lineno for n in ast.walk(scope)
+                               if isinstance(n, (ast.Assign,
+                                                 ast.AugAssign,
+                                                 ast.AnnAssign,
+                                                 ast.For))
+                               and n.lineno >= node.lineno
+                               and name in _bound_names(n)]
+                    cut = min(rebinds) if rebinds else float("inf")
+                    for use in ast.walk(scope):
+                        if (isinstance(use, ast.Name)
+                                and isinstance(use.ctx, ast.Load)
+                                and use.id == name
+                                and end < use.lineno < cut
+                                and not any(lo <= use.lineno <= hi
+                                            for lo, hi in spans)):
+                            yield self.finding(
+                                mi, use,
+                                f"`{name}` was donated to jit root "
+                                f"`{callee.name}` (line {node.lineno}) "
+                                f"— its buffer is deleted after the "
+                                f"call; use the returned value, or "
+                                f"drop the donation if callers must "
+                                f"reuse the input",
+                                symbol=fi.qualname if fi else "",
+                            )
+                            break  # one finding per donated name/call

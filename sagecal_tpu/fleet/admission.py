@@ -54,11 +54,13 @@ class AdmissionController:
 
     def __init__(self, specs: Dict[str, SLOSpec],
                  policy: str = "degrade",
-                 degrade_emiter: int = 1, degrade_lbfgs: int = 4):
+                 degrade_emiter: int = 1, degrade_lbfgs: int = 4,
+                 clock=time.time):
         if policy not in POLICIES:
             raise ValueError(
                 f"overload policy {policy!r} not in {POLICIES}")
         self.policy = policy
+        self.clock = clock  # injectable so burn windows are checkable
         self.degrade_emiter = int(degrade_emiter)
         self.degrade_lbfgs = int(degrade_lbfgs)
         self.monitor = SLOMonitor(specs)
@@ -86,7 +88,7 @@ class AdmissionController:
                 continue  # sheds don't burn (see class docstring)
             self.monitor.observe(
                 str(r.get("tenant", "")),
-                float(r.get("completed_at") or 0.0) or time.time(),
+                float(r.get("completed_at") or 0.0) or self.clock(),
                 float(r.get("latency_s", 0.0)),
                 str(r.get("verdict", "")))
             new += 1
@@ -143,7 +145,7 @@ class AdmissionController:
         (marked seen locally so a later rescan doesn't re-ingest it)."""
         from sagecal_tpu.serve.request import write_result_manifest
 
-        now = time.time()
+        now = self.clock()
         req = item.request
         result = {
             "request_id": item.request_id,

@@ -44,7 +44,7 @@ def bucket_hint_for(meta, tilesz: int, nchan_avg: bool = True) -> str:
 
 def seed_queue(queue: LeaseQueue, requests, specs,
                large_stations: int = 0,
-               log=print) -> List[WorkItem]:
+               log=print, now: Optional[float] = None) -> List[WorkItem]:
     """One WorkItem per request.  ``specs`` is the tenant SLO map
     (deadline_s -> absolute EDF deadlines); datasets are opened once
     each for their shape metadata."""
@@ -52,7 +52,7 @@ def seed_queue(queue: LeaseQueue, requests, specs,
 
     metas: Dict[str, Any] = {}
     items: List[WorkItem] = []
-    now = time.time()
+    now = queue.clock() if now is None else float(now)
     for r in requests:
         path = os.path.abspath(r.dataset)
         meta = metas.get(path)
@@ -115,12 +115,13 @@ def worker_argv(cfg, index: int) -> List[str]:
 class FleetCoordinator:
     """Seed + spawn + watch + report."""
 
-    def __init__(self, cfg, log=print):
+    def __init__(self, cfg, log=print, clock=time.time):
         self.cfg = cfg
         self.log = log
+        self.clock = clock  # injectable so watch deadlines are checkable
         self.queue = LeaseQueue(
             cfg.queue_dir or os.path.join(cfg.out_dir, "queue"),
-            worker="coordinator", ttl_s=cfg.lease_ttl_s)
+            worker="coordinator", ttl_s=cfg.lease_ttl_s, clock=clock)
         self.procs: List[subprocess.Popen] = []
 
     def spawn_workers(self, n: Optional[int] = None) -> None:
@@ -141,7 +142,7 @@ class FleetCoordinator:
               poll_s: float = 1.0) -> bool:
         """Poll until every item is done or every worker exited.
         Returns True iff the queue fully drained."""
-        t0 = time.time()
+        t0 = self.clock()
         last_stats = ""
         while True:
             if self.queue.all_done():
@@ -157,7 +158,7 @@ class FleetCoordinator:
                 last_stats = line
             if not alive:
                 return self.queue.all_done()
-            if timeout_s and time.time() - t0 > timeout_s:
+            if timeout_s and self.clock() - t0 > timeout_s:
                 return self.queue.all_done()
             time.sleep(poll_s)
 
@@ -165,11 +166,11 @@ class FleetCoordinator:
         for p in self.procs:
             if p.poll() is None:
                 p.terminate()
-        deadline = time.time() + grace_s
+        deadline = self.clock() + grace_s
         for p in self.procs:
             if p.poll() is None:
                 try:
-                    p.wait(timeout=max(deadline - time.time(), 0.1))
+                    p.wait(timeout=max(deadline - self.clock(), 0.1))
                 except subprocess.TimeoutExpired:
                     p.kill()
                     p.wait()
@@ -220,7 +221,7 @@ class FleetCoordinator:
     def run(self, requests, elog=None) -> Dict[str, Any]:
         from sagecal_tpu.obs.slo import load_slo_specs
 
-        t0 = time.time()
+        t0 = self.clock()
         os.makedirs(self.cfg.out_dir, exist_ok=True)
         specs = {}
         if self.cfg.slo:
@@ -241,7 +242,7 @@ class FleetCoordinator:
             self.shutdown()
         summary = self.summary(requests)
         summary["drained"] = drained
-        summary["wall_s"] = time.time() - t0
+        summary["wall_s"] = self.clock() - t0
         if elog is not None:
             elog.emit("fleet_done", **{
                 k: v for k, v in summary.items() if k != "slo"})

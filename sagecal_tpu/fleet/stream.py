@@ -112,12 +112,13 @@ class StreamCalibrator:
     """One stream process: window loop + warm-start chain + lease-aware
     checkpoints + per-window result manifests."""
 
-    def __init__(self, cfg, log=print, device=None):
+    def __init__(self, cfg, log=print, device=None, clock=time.time):
         from sagecal_tpu.obs.aggregate import worker_id
 
         self.cfg = cfg
         self.log = log
         self.device = device
+        self.clock = clock  # injectable so lease logic is checkable
         self.owner = worker_id()
 
     # -- config plumbing ----------------------------------------------
@@ -185,7 +186,7 @@ class StreamCalibrator:
         from sagecal_tpu.solvers.sage import build_cluster_data, solve_tile
 
         cfg = self.cfg
-        t_start = time.time()
+        t_start = self.clock()
         dtype = np.float64 if cfg.use_f64 else np.float32
         cdtype = np.complex128 if cfg.use_f64 else np.complex64
         os.makedirs(cfg.out_dir, exist_ok=True)
@@ -225,23 +226,50 @@ class StreamCalibrator:
                 every=max(cfg.checkpoint_every, 1), elog=elog,
                 log=self.log)
             if cfg.resume:
-                found = ckmgr.resume()
-                if found is not None:
+                # Three-phase adoption (read -> gate -> confirm), the
+                # shape the protocol model checker verifies: gating on
+                # a checkpoint that is no longer the newest would let
+                # us adopt a window the live owner has already moved
+                # past (the stale-read fork).  The confirm re-read
+                # detects a chain that advanced between our read and
+                # the lease gate and restarts the adoption attempt.
+                for _ in range(8):
+                    found = ckmgr.resume()
+                    if found is None:
+                        break
                     rmeta, rarr, rpath = found
                     # refuse a chain another live process still owns
                     check_owner_lease(rmeta, self.owner)
+                    again = ckmgr.resume()
+                    if again is not None and again[2] != rpath:
+                        continue
                     resume_done = int(rmeta["windows_done"])
                     p = jnp.asarray(rarr["p"])
                     rng_key = jnp.asarray(rarr["rng_key"])
                     self.log(f"stream: adopted chain at window "
                              f"{resume_done} from {rpath} (previous "
                              f"owner {rmeta.get('owner')!r})")
+                    break
+                else:
+                    # the chain advanced on every attempt: somebody is
+                    # actively writing it, whatever their lease file
+                    # said at the instants we sampled it
+                    from sagecal_tpu.elastic.checkpoint import \
+                        ResumeRefused
+
+                    raise ResumeRefused(
+                        "checkpoint chain kept advancing during "
+                        "adoption; a live owner is writing it")
 
         sol_path = os.path.join(cfg.out_dir, f"{stem}.stream.solutions")
+        # jaxlint: disable=JL008 — deliberate append-chain: solutions
+        # must grow across resumed runs (tmp+replace cannot express an
+        # append); consumed post-hoc by readers that tolerate a torn
+        # tail, and no protocol decision reads this file
         if resume_done:
-            sol_fh = open(sol_path, "a")
+            sol_fh = open(sol_path, "a")  # jaxlint: disable=JL008 — see above
         else:
-            sol_fh = open(sol_path, "w")
+            sol_fh = open(sol_path, "w")  # jaxlint: disable=JL008 — see above
             solio.write_header(
                 sol_fh, meta.freq0, meta.deltaf,
                 meta.deltat * cfg.window / 60.0, N, M, M * nchunk_max)
@@ -249,6 +277,13 @@ class StreamCalibrator:
         latencies: List[float] = []
         results: List[Dict[str, Any]] = []
         warm_count = resets = 0
+        # our own lease deadline (0.0 until the first checkpoint is
+        # published); once it passes, a successor may have adopted the
+        # chain, so we fence off ALL further chain writes.  A TTL of 0
+        # disables leasing — every lease is born expired, so there is
+        # no ownership to fence and the deadline stays unarmed.
+        lease_deadline = 0.0
+        fenced = False
         try:
             for w, t0 in enumerate(windows):
                 if w < resume_done:
@@ -258,7 +293,7 @@ class StreamCalibrator:
                 data = ds.load_tile(t0, cfg.window,
                                     average_channels=True, dtype=dtype,
                                     column=cfg.in_column)
-                data_ready = time.time()
+                data_ready = self.clock()
                 cdata = build_cluster_data(data, clusters, nchunks,
                                            shapelets=shapelets)
                 warm = bool(cfg.warm_start and w > 0)
@@ -290,7 +325,7 @@ class StreamCalibrator:
                     M * nchunk_max, N, 2, 2)
                 solio.append_solutions(sol_fh, jsol)
                 sol_fh.flush()
-                done = time.time()
+                done = self.clock()
                 latency = done - data_ready
                 latencies.append(latency)
                 warm_count += int(warm)
@@ -310,14 +345,35 @@ class StreamCalibrator:
                 }
                 write_result_manifest(cfg.out_dir, result)
                 results.append(result)
-                if ckmgr is not None:
-                    now = time.time()
-                    ckmgr.update(
-                        w,
-                        {"p": np.asarray(p),
-                         "rng_key": np.asarray(rng_key)},
-                        windows_done=w + 1, owner=self.owner,
-                        lease_expires_at=now + cfg.lease_ttl_s)
+                if ckmgr is not None and not fenced:
+                    now = self.clock()
+                    if 0.0 < lease_deadline <= now:
+                        # self-fence: our lease expired before this
+                        # renewal, so a successor may already own the
+                        # chain — republishing would resurrect our
+                        # stale state over its writes.  Keep solving
+                        # (manifests are deterministic and idempotent)
+                        # but never touch the chain again, not even
+                        # from the signal-time crash flusher.
+                        fenced = True
+                        ckmgr.close()
+                        self.log(
+                            f"stream: owner lease expired "
+                            f"{now - lease_deadline:.1f}s ago; fencing "
+                            "off checkpoint writes — a successor may "
+                            "own the chain")
+                        if elog is not None:
+                            elog.emit("stream_lease_fenced", window=w,
+                                      deadline=lease_deadline, now=now)
+                    else:
+                        ckmgr.update(
+                            w,
+                            {"p": np.asarray(p),
+                             "rng_key": np.asarray(rng_key)},
+                            windows_done=w + 1, owner=self.owner,
+                            lease_expires_at=now + cfg.lease_ttl_s)
+                        if cfg.lease_ttl_s > 0:
+                            lease_deadline = now + cfg.lease_ttl_s
                 if elog is not None:
                     elog.emit("stream_window", window=w, t0=t0,
                               warm=warm, latency_s=latency,
@@ -326,20 +382,31 @@ class StreamCalibrator:
                          f"{'warm' if warm else 'cold'} "
                          f"residual {res0:.6f} -> {res1:.6f} "
                          f"({latency:.2f}s to solution)")
-            if ckmgr is not None:
+            if ckmgr is not None and not fenced:
                 # clean completion: RELEASE the owner lease so a
                 # successor process can adopt the chain immediately
                 # (only a crashed run — this line never reached —
-                # holds its lease until the TTL runs out)
-                ckmgr.update(len(windows),
-                             {"p": np.asarray(p),
-                              "rng_key": np.asarray(rng_key)},
-                             windows_done=len(windows),
-                             owner=self.owner, lease_expires_at=0.0)
+                # holds its lease until the TTL runs out).  The same
+                # fence applies: an expired lease means the release is
+                # no longer ours to publish.
+                now = self.clock()
+                if 0.0 < lease_deadline <= now:
+                    fenced = True
+                    ckmgr.close()
+                    self.log("stream: owner lease expired before "
+                             "release; leaving the chain to its "
+                             "successor")
+                else:
+                    ckmgr.update(len(windows),
+                                 {"p": np.asarray(p),
+                                  "rng_key": np.asarray(rng_key)},
+                                 windows_done=len(windows),
+                                 owner=self.owner, lease_expires_at=0.0)
         finally:
             sol_fh.close()
             if ckmgr is not None:
-                ckmgr.flush()
+                if not fenced:
+                    ckmgr.flush()
                 ckmgr.close()
             ds.close()
 
@@ -349,12 +416,13 @@ class StreamCalibrator:
             "resumed_from": resume_done,
             "warm": warm_count,
             "resets": resets,
+            "lease_fenced": fenced,
             "first_window_latency_s": latencies[0] if latencies else 0.0,
             "latency_to_first_solution_s":
                 steady_state_latency(latencies),
             "latencies_s": latencies,
             "solutions": sol_path,
-            "wall_s": time.time() - t_start,
+            "wall_s": self.clock() - t_start,
         }
         if elog is not None:
             elog.emit("stream_done", **{

@@ -57,7 +57,7 @@ def _request_from_item(item: WorkItem):
 class FleetWorker:
     """One claim-solve-complete loop over the shared queue."""
 
-    def __init__(self, cfg, log=print, device=None):
+    def __init__(self, cfg, log=print, device=None, clock=time.time):
         from sagecal_tpu.obs.aggregate import worker_id
         from sagecal_tpu.serve.aot_store import AOTArtifactStore
         from sagecal_tpu.serve.cache import ExecutableCache
@@ -65,10 +65,11 @@ class FleetWorker:
         self.cfg = cfg
         self.log = log
         self.device = device
+        self.clock = clock  # injectable so deadline logic is checkable
         self.wid = cfg.worker_id or worker_id()
         self.queue = LeaseQueue(
             cfg.queue_dir or os.path.join(cfg.out_dir, "queue"),
-            worker=self.wid, ttl_s=cfg.lease_ttl_s)
+            worker=self.wid, ttl_s=cfg.lease_ttl_s, clock=clock)
         self.store = AOTArtifactStore(
             cfg.aot_store or os.path.join(cfg.out_dir, "aot-store"))
         # ONE executable cache for the worker's whole life: the
@@ -210,7 +211,7 @@ class FleetWorker:
 
         req = _request_from_item(item)
         cfg = self.cfg
-        t_start = time.time()
+        t_start = self.clock()
         dtype = np.float64 if cfg.use_f64 else np.float32
         cdtype = np.complex128 if cfg.use_f64 else np.complex64
         with VisDataset(req.dataset, "r") as ds:
@@ -245,12 +246,18 @@ class FleetWorker:
             cfg.out_dir, f"{req.request_id}.solutions")
         jsol = np.asarray(params_to_jones(np.asarray(p))).reshape(
             M * nchunk_max, N, 2, 2)
-        with open(out_path, "w") as fh:
+        # tmp + replace: a zombie whose lease was stolen may write the
+        # same solutions path concurrently with the stealer — both
+        # produce identical bytes, and the atomic rename keeps the
+        # published file whole at every instant
+        tmp_path = f"{out_path}.tmp.{os.getpid()}"
+        with open(tmp_path, "w") as fh:
             solio.write_header(
                 fh, meta.freq0, meta.deltaf,
                 meta.deltat * req.tilesz / 60.0, N, M, M * nchunk_max)
             solio.append_solutions(fh, jsol)
-        now = time.time()
+        os.replace(tmp_path, out_path)
+        now = self.clock()
         result = {
             "request_id": req.request_id, "tenant": req.tenant,
             "dataset": req.dataset, "t0": req.t0,
@@ -336,7 +343,7 @@ class FleetWorker:
                         continue
                     attempts = self.queue.record_failure(rid, repr(e))
                     if attempts >= MAX_ATTEMPTS:
-                        now = time.time()
+                        now = self.clock()
                         write_result_manifest(self.cfg.out_dir, {
                             "request_id": rid, "tenant": it.tenant,
                             "verdict": "error",
@@ -384,7 +391,7 @@ class FleetWorker:
 
         cfg, reg = self.cfg, get_registry()
         os.makedirs(cfg.out_dir, exist_ok=True)
-        t0 = time.time()
+        t0 = self.clock()
         idle_since: Optional[float] = None
         while True:
             claimed = self.claim_cycle()
@@ -400,7 +407,7 @@ class FleetWorker:
                 continue
             if self.queue.all_done():
                 break
-            now = time.time()
+            now = self.clock()
             if idle_since is None:
                 idle_since = now
             elif now - idle_since > cfg.max_idle_s:
@@ -408,7 +415,7 @@ class FleetWorker:
                 # peers): let the coordinator's view decide the end
                 break
             time.sleep(cfg.poll_s)
-        wall = time.time() - t0
+        wall = self.clock() - t0
         summary = {
             "worker": self.wid, "cycles": self.cycles,
             "solved": self.solved, "wall_s": wall,

@@ -600,6 +600,24 @@ def _cmd_lint(args) -> int:
     return lint_main(args.lint_args)
 
 
+def _cmd_protocol(args) -> int:
+    """Exhaustively model-check the fleet lease/stream protocols
+    (real queue + owner-lease code over the simulated fs).  Exit 0
+    when every invariant holds on every reachable state, 1 on any
+    violation (with the shortest counterexample trace printed)."""
+    from sagecal_tpu.analysis.protocol_check import run_protocol_check
+
+    report = run_protocol_check(
+        workers=args.workers, crash_budget=args.crashes,
+        tick_budget=args.ticks, deadline_s=args.deadline,
+        log=print)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    if not report["ok"]:
+        return 1
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="sagecal-tpu diag",
@@ -715,13 +733,31 @@ def build_parser() -> argparse.ArgumentParser:
 
     lp = sub.add_parser(
         "lint",
-        help="jaxlint static-analysis gate (JL001-JL006 + JL900)",
+        help="jaxlint static-analysis gate (JL001-JL011 + JL900)",
     )
     lp.add_argument("lint_args", nargs=argparse.REMAINDER,
                     help="arguments forwarded to jaxlint "
                          "(paths, --format, --baseline, --rules, ...); "
                          "default lints the installed sagecal_tpu")
     lp.set_defaults(fn=_cmd_lint)
+
+    pcp = sub.add_parser(
+        "protocol",
+        help="model-check the fleet lease + stream owner-lease "
+             "protocols (exhaustive interleavings, crash injection)",
+    )
+    pcp.add_argument("--workers", type=int, default=2,
+                     help="logical queue workers to interleave "
+                          "(default 2 = exhaustive in seconds)")
+    pcp.add_argument("--crashes", type=int, default=1,
+                     help="crash injections per schedule (default 1)")
+    pcp.add_argument("--ticks", type=int, default=2,
+                     help="clock advances per schedule (default 2)")
+    pcp.add_argument("--deadline", type=float, default=55.0,
+                     help="per-scenario exploration deadline seconds")
+    pcp.add_argument("--json", action="store_true",
+                     help="print the full report as JSON")
+    pcp.set_defaults(fn=_cmd_protocol)
     return ap
 
 

@@ -376,11 +376,16 @@ class CalibrationService:
         N, M, nchunk_max = meta.nstations, entry.nclus, entry.nchunk_max
         jsol = np.asarray(params_to_jones(p)).reshape(
             M * nchunk_max, N, 2, 2)
-        with open(out_path, "w") as fh:
+        # tmp + replace: the published solutions file is whole at
+        # every instant (a reader never sees a header without its
+        # solutions)
+        tmp_path = f"{out_path}.tmp.{os.getpid()}"
+        with open(tmp_path, "w") as fh:
             solio.write_header(
                 fh, meta.freq0, meta.deltaf,
                 meta.deltat * req.tilesz / 60.0, N, M, M * nchunk_max)
             solio.append_solutions(fh, jsol)
+        os.replace(tmp_path, out_path)
 
         from sagecal_tpu.obs.trace import get_tracer
 

@@ -94,6 +94,10 @@ def make_synthetic_workload(workdir: str, n_requests: int,
             "max_emiter": 1, "max_iter": 2, "max_lbfgs": 4,
         })
     manifest = os.path.join(workdir, "requests.json")
-    with open(manifest, "w") as f:
+    # tmp + replace: a concurrently-starting worker never reads a
+    # half-written request manifest
+    tmp = f"{manifest}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump({"requests": requests}, f, indent=1)
+    os.replace(tmp, manifest)
     return manifest

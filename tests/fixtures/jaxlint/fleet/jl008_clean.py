@@ -1,0 +1,22 @@
+"""Must-not-fire fixture for JL008: the atomic staging idiom, read
+mode, and a write to non-protocol state are all exempt."""
+import json
+import os
+
+
+def write_manifest_atomic(out_dir, doc):
+    path = os.path.join(out_dir, "result-r1.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+def read_manifest(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_scratch_note(out_dir, text):
+    with open(os.path.join(out_dir, "notes.txt"), "w") as f:
+        f.write(text)

@@ -1,0 +1,21 @@
+"""Should-fire fixture for JL008 (lives under fleet/ for path scope):
+three non-atomic writes to protocol-state paths."""
+import json
+import os
+
+
+def write_manifest(out_dir, doc):
+    path = os.path.join(out_dir, "result-r1.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def publish_lease(root, rid, doc):
+    f = open(f"{root}/lease-{rid}.e000001.json", "w")
+    f.write(json.dumps(doc))
+    f.close()
+
+
+def append_queue(queue_path, line):
+    with open(queue_path, "a") as f:
+        f.write(line)

@@ -1,0 +1,22 @@
+"""Must-not-fire fixture for JL010: the injectable-clock idioms
+(constructor default, ``now=None`` parameter) and non-lease timing."""
+import time
+
+
+class Watcher:
+    def __init__(self, ttl_s, clock=time.time):
+        self.ttl_s = ttl_s
+        self.clock = clock
+
+    def lease_live(self, doc, now=None):
+        now = time.time() if now is None else float(now)
+        return float(doc.get("expires_at", 0.0)) > now
+
+    def next_expiry(self, docs, now=None):
+        now = self.clock() if now is None else float(now)
+        return min(float(d["expires_at"]) for d in docs
+                   if float(d["expires_at"]) > now)
+
+
+def wall_elapsed(t0):
+    return time.time() - t0

@@ -1,0 +1,12 @@
+"""Should-fire fixture for JL010 (lives under fleet/ for path scope):
+raw wall-clock reads inside lease/deadline predicates."""
+import time
+
+
+def lease_live(doc):
+    return float(doc.get("expires_at", 0.0)) > time.time()
+
+
+def deadline_for(enqueued_at, ttl_s):
+    deadline = time.time() + ttl_s
+    return max(deadline, enqueued_at)

@@ -1,0 +1,20 @@
+"""Must-not-fire fixture for JL009: the aot_store pattern — a
+plain-text magic/version header validated before pickle touches the
+stream, mismatch treated as a miss."""
+import json
+import pickle
+
+_MAGIC = "sagecal-aot-v1"
+
+
+def load_artifact(path):
+    try:
+        with open(path, "rb") as f:
+            header = json.loads(f.readline().decode("utf-8"))
+            if header.get("magic") != _MAGIC:
+                raise ValueError("bad magic")
+            if header.get("jaxlib_version") != "expected":
+                raise ValueError("version mismatch")
+            return pickle.load(f)
+    except Exception:
+        return None

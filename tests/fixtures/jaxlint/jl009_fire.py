@@ -1,0 +1,12 @@
+"""Should-fire fixture for JL009: unpickling shared artifacts with no
+header gate in sight."""
+import pickle
+
+
+def load_artifact(path):
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def load_blob(blob):
+    return pickle.loads(blob)

@@ -1,0 +1,23 @@
+"""Must-not-fire fixture for JL011: consuming idioms — the donated
+name is rebound from the call's result (directly or by tuple
+unpacking) before any further use."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   donate_argnames=("memory",))
+def fit(p0, memory):
+    return p0 + memory, memory
+
+
+def consuming_caller(p0, memory):
+    p0, memory = fit(p0, memory=memory)
+    return p0, memory
+
+
+def loop_caller(p0, memory):
+    for _ in range(3):
+        p0, memory = fit(p0, memory=memory)
+    return p0, memory

@@ -1,0 +1,18 @@
+"""Should-fire fixture for JL011: reading a buffer after donating it
+to a jit root (positional donate_argnums and keyword donate_argnames)."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   donate_argnames=("memory",))
+def fit(p0, memory):
+    return p0 + memory, memory
+
+
+def caller(p0, memory):
+    out, mem = fit(p0, memory=memory)
+    total = p0.sum()
+    stale = memory
+    return out, mem, total, stale

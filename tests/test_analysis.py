@@ -61,6 +61,10 @@ FIRE_CASES = [
     ("JL005", "jl005_fire.py", 4),
     ("JL006", "jl006_fire.py", 2),
     ("JL007", "jl007_fire.py", 3),
+    ("JL008", os.path.join("fleet", "jl008_fire.py"), 3),
+    ("JL009", "jl009_fire.py", 2),
+    ("JL010", os.path.join("fleet", "jl010_fire.py"), 2),
+    ("JL011", "jl011_fire.py", 2),
     ("JL900", "jl900_fixture.py", 2),
 ]
 
@@ -71,6 +75,10 @@ CLEAN_CASES = [
     ("JL004", os.path.join("solvers", "jl004_clean.py")),
     ("JL005", "jl005_clean.py"),
     ("JL007", "jl007_clean.py"),
+    ("JL008", os.path.join("fleet", "jl008_clean.py")),
+    ("JL009", "jl009_clean.py"),
+    ("JL010", os.path.join("fleet", "jl010_clean.py")),
+    ("JL011", "jl011_clean.py"),
 ]
 
 
@@ -176,6 +184,21 @@ class TestPragmasAndBaseline:
         assert {f.rule for f in new2} == {"JL006"}
         assert len(old2) == len(findings)
 
+    def test_baseline_preserves_why_on_rewrite(self, tmp_path):
+        # a justification attached to a deliberate finding survives
+        # --update-baseline rewrites
+        findings = rules_fired(fx("jl001_fire.py"))
+        bl_path = str(tmp_path / "bl.json")
+        baseline_mod.save_baseline(bl_path, findings)
+        data = json.load(open(bl_path))
+        data["findings"][0]["why"] = "deliberate: fixture reason"
+        with open(bl_path, "w") as f:
+            json.dump(data, f)
+        baseline_mod.save_baseline(bl_path, findings)
+        data2 = json.load(open(bl_path))
+        whys = [r.get("why") for r in data2["findings"] if r.get("why")]
+        assert whys == ["deliberate: fixture reason"]
+
     def test_cli_baseline_gate(self, tmp_path, capsys):
         bl = str(tmp_path / "bl.json")
         target = fx("jl003_fire.py")
@@ -201,7 +224,8 @@ class TestCLI:
         assert lint_cli.main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rid in ("JL001", "JL002", "JL003", "JL004", "JL005",
-                    "JL006", "JL007", "JL900"):
+                    "JL006", "JL007", "JL008", "JL009", "JL010",
+                    "JL011", "JL900"):
             assert rid in out
         assert "report-only" in out
 

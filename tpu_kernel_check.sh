@@ -99,10 +99,10 @@ if probe; then
 fi
 echo "=== bf16-coherency fused bench"
 if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
-echo "=== telemetry+quality+trace+serve_obs+fleet+stream test pass (CPU, marker-driven)"
+echo "=== telemetry+quality+trace+serve_obs+fleet+stream+protocol test pass (CPU, marker-driven)"
 JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 1200 \
   python -m pytest tests/ -q \
-  -m "telemetry or quality or trace or serve_obs or fleet or stream" \
+  -m "telemetry or quality or trace or serve_obs or fleet or stream or protocol" \
   -p no:cacheprovider | tail -3
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then echo "telemetry test pass FAILED rc=$rc"; exit 1; fi
@@ -284,6 +284,15 @@ print('spatial smoke ok: k_aic=%d k_mdl=%d fista fit %.2e nnz=%d'
   || { echo "spatial smoke validate FAILED"; exit 1; }
 rm -rf "$SPDIR"
 rm -rf "$SPDIR"
+echo "=== protocol model check (exhaustive 2-worker interleavings + crash injection)"
+# before trusting the live fleet smoke below, prove the lease + stream
+# owner-lease protocols correct over EVERY schedule the smoke could
+# sample: all interleavings of 2 logical workers with a crash injected
+# at each fs-op boundary and clock ticks across every TTL expiry,
+# asserting no double-claim, no lost/duplicated item, steal only after
+# expiry, no torn manifest, live foreign chains refused
+JAX_PLATFORMS=cpu timeout 90 python -m sagecal_tpu.obs.diag protocol \
+  || { echo "PROTOCOL MODEL CHECK FAILED"; exit 1; }
 echo "=== two-worker fleet smoke (CPU, kill one worker mid-run)"
 # the fleet lease protocol under real fire: 6 mixed-shape requests into
 # the shared queue, 2 subprocess workers, one SIGKILLed mid-run — its
