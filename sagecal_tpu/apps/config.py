@@ -221,6 +221,16 @@ class ServeConfig:
     checkpoint_every: int = 0
     checkpoint_dir: Optional[str] = None
     use_f64: bool = True
+    # route the solves' joint-LBFGS phase through the fused Pallas RIME
+    # kernels — batched (one grid per batch) when the bucket passes the
+    # capability checks of solvers/batched.choose_batched_path, vmapped
+    # solo kernels or the XLA predict otherwise.  f32 only: combined
+    # with use_f64=True the fused request is ignored (fullbatch
+    # precedent) and the dispatch stays on the XLA path.
+    use_fused_predict: bool = False
+    # coherency-stack dtype on the fused paths ("f32" | "bf16"; see
+    # RunConfig.coh_dtype)
+    coh_dtype: str = "f32"
     verbose: bool = False
     # per-tenant SLO specs (obs/slo.py): path to a slo.json; empty
     # falls back to any "slos" key inside the request manifest
@@ -286,6 +296,11 @@ class FleetConfig:
     checkpoint_every: int = 0
     checkpoint_dir: Optional[str] = None
     use_f64: bool = True
+    # fused-kernel routing for the workers' batch solves (ServeConfig
+    # semantics: batched fused kernel when capability checks pass,
+    # ignored under use_f64)
+    use_fused_predict: bool = False
+    coh_dtype: str = "f32"
     verbose: bool = False
     slo: str = ""
     max_streams: int = 8

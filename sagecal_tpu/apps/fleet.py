@@ -83,6 +83,15 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-R", "--no-randomize", action="store_true")
     ap.add_argument("--f32", action="store_true",
                     help="solve in float32 (TPU-native precision)")
+    ap.add_argument("--fused", action="store_true",
+                    help="route workers' batch solves through the fused "
+                    "Pallas kernels — one batched grid per bucket when "
+                    "the capability checks pass.  Requires --f32; "
+                    "ignored under f64")
+    ap.add_argument("--coh-dtype", choices=("f32", "bf16"), default="f32",
+                    help="coherency-stack storage dtype on the fused "
+                    "paths (bf16 halves the dominant HBM stream, f32 "
+                    "accumulation)")
     ap.add_argument("--slo", default="",
                     help="per-tenant SLO specs (slo.json); also drives "
                     "admission control deadlines; falls back to a "
@@ -108,7 +117,8 @@ def config_from_args(args) -> FleetConfig:
         max_lbfgs=args.max_lbfgs, lbfgs_m=args.lbfgs_m,
         solver_mode=args.solver_mode, nulow=args.nulow,
         nuhigh=args.nuhigh, randomize=not args.no_randomize,
-        use_f64=not args.f32, verbose=args.verbose, slo=args.slo)
+        use_f64=not args.f32, use_fused_predict=args.fused,
+        coh_dtype=args.coh_dtype, verbose=args.verbose, slo=args.slo)
 
 
 def _obs_setup(cfg, role: str):

@@ -48,6 +48,16 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-R", "--no-randomize", action="store_true")
     ap.add_argument("--f32", action="store_true",
                     help="solve in float32 (TPU-native precision)")
+    ap.add_argument("--fused", action="store_true",
+                    help="route batch solves' joint-LBFGS through the "
+                    "fused Pallas kernels — ONE batched grid per bucket "
+                    "when the capability checks pass (solvers/batched."
+                    "choose_batched_path), vmapped solo kernels or XLA "
+                    "otherwise.  Requires --f32; ignored under f64")
+    ap.add_argument("--coh-dtype", choices=("f32", "bf16"), default="f32",
+                    help="coherency-stack storage dtype on the fused "
+                    "paths (bf16 halves the dominant HBM stream, f32 "
+                    "accumulation)")
     ap.add_argument("--abort-on-divergence", action="store_true")
     ap.add_argument("--resume", action="store_true",
                     help="skip requests a previous (preempted) server "
@@ -79,6 +89,7 @@ def config_from_args(args) -> ServeConfig:
         abort_on_divergence=args.abort_on_divergence,
         resume=args.resume, checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir, use_f64=not args.f32,
+        use_fused_predict=args.fused, coh_dtype=args.coh_dtype,
         verbose=args.verbose, slo=args.slo, aot_store=args.aot_store,
         max_streams=args.max_streams)
 
@@ -118,8 +129,14 @@ def _run_serve_host(cfg: ServeConfig, requests, log, accel):
 
     if requests is None:
         requests = load_requests(cfg.requests)
+    # manifest stamps the CONFIGURED routing intent; the path each batch
+    # actually executed is recorded per dispatch in the
+    # ``serve_batch_dispatched`` events (kernel_path / kernel_path_reason)
+    fused_intent = (getattr(cfg, "use_fused_predict", False)
+                    and not cfg.use_f64)
     manifest = RunManifest.collect(
-        kernel_path="xla", app="serve", requests=len(requests),
+        kernel_path="fused" if fused_intent else "xla", app="serve",
+        requests=len(requests),
         tenants=len({r.tenant for r in requests}), batch=cfg.batch,
         out_dir=cfg.out_dir)
     elog = default_event_log(manifest=manifest)

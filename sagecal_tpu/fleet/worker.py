@@ -102,7 +102,10 @@ class FleetWorker:
             randomize=c.randomize, res_ratio=c.res_ratio,
             abort_on_divergence=False, resume=False,
             checkpoint_every=0, checkpoint_dir=None,
-            use_f64=c.use_f64, verbose=c.verbose, slo="",
+            use_f64=c.use_f64,
+            use_fused_predict=getattr(c, "use_fused_predict", False),
+            coh_dtype=getattr(c, "coh_dtype", "f32"),
+            verbose=c.verbose, slo="",
             max_streams=c.max_streams)
 
     # -- lease upkeep --------------------------------------------------

@@ -1052,6 +1052,298 @@ def fused_cost_packed_hybrid_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
     return jnp.sum(jax.lax.map(one, jnp.arange(n)))
 
 
+# ---------------------------------------------- batched fused objective
+#
+# One Pallas grid evaluating the fused objective for a BATCH of lanes
+# (independent same-shape solves — the serve path's tenants).  The lane
+# axis is folded into the GEMM M dimension: batched gain tables are
+# (4, B*Mp, NPAD) lane-major, so the one-hot selection matmuls become
+# (B*Mp, NPAD) @ (NPAD, T) — B times the MXU rows of a solo dispatch
+# per pass, instead of B separate grids of tiny 2x2 arithmetic.  All
+# the solo (rows, T)-plane helpers (_expand_gains, _load_coh_planes,
+# _rime_products, _bwd_accumulate, _bwd_store) are reused unchanged
+# with rows := B*Mp; only the residual/cost stage is lane-aware:
+# per-lane cluster reduction via a leading-dim (B*Mp, T) -> (B, Mp, T)
+# reshape (a pure sublane view — no minor-dim relayout), per-lane
+# masked residual against (B, T) vis planes, per-lane partial costs
+# accumulated into a (B, rowsp) output.  The backward forms each
+# lane's residual cotangent in-register and broadcasts it back across
+# the lane's Mp cluster rows, then the solo accumulate/scatter path
+# runs unchanged on (B*Mp, T) planes.
+#
+# Capability contract (enforced host-side by solvers.batched):
+#   - nc == 1 only (no hybrid time chunks on the batched path);
+#   - ant_p/ant_q SHARED across lanes (one (1, rowsp) plane — a serve
+#     bucket guarantees identical baseline geometry);
+#   - per-lane nu crosses as a (B, NPAD) f32 plane (column-replicated
+#     scalar per lane; a traced EM mean_nu never recompiles);
+#   - VMEM: the backward carries 16 (B*Mp, T) accumulators, so the
+#     solo tile bound applies with B*Mp in the cluster-row position
+#     (B*Mp <~ 104 at tile 128 on the v5e — the serve shapes' 8-row
+#     cluster blocks allow B up to 13 at full tile).
+#
+# Ragged-lane guard: replication-padded lanes are neutralized by
+# zeroing their mask plane at pack time (``valid``), which makes their
+# cost exactly 0.0 and their gain cotangent exactly 0 — the padded
+# lane cannot perturb the batch and is discarded host-side.
+
+
+def _shape_args_batch(tab_re, coh_ri, vis_ri, mask_p, tile):
+    four, mrows, npad = tab_re.shape
+    B, F, eight, rowsp = vis_ri.shape
+    assert four == 4 and npad == NPAD and eight == 8
+    assert mrows % B == 0, (mrows, B)
+    Mp = mrows // B
+    assert coh_ri.shape == (mrows, F, 8, rowsp), (coh_ri.shape, vis_ri.shape)
+    assert mask_p.shape == (B, F, rowsp)
+    assert Mp % 8 == 0 and rowsp % tile == 0, (Mp, rowsp, tile)
+    return B, Mp, F, rowsp, rowsp // tile
+
+
+def _bvis_spec(B, F, tile):
+    return pl.BlockSpec((B, F, 8, tile), lambda r: (0, 0, 0, r),
+                        memory_space=pltpu.VMEM)
+
+
+def _bmask_spec(B, F, tile):
+    return pl.BlockSpec((B, F, tile), lambda r: (0, 0, r),
+                        memory_space=pltpu.VMEM)
+
+
+def _bnu_spec(B):
+    return pl.BlockSpec((B, NPAD), lambda r: (0, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _lane_sum(plane, B, MP, T):
+    """Per-lane cluster reduction: (B*MP, T) product plane -> (B, T).
+    Leading-dim reshape only (a sublane-order view, Mosaic-safe like
+    the hybrid path's (mp, nc, T) split)."""
+    return jnp.sum(plane.reshape(B, MP, T), axis=1)
+
+
+def _lane_bcast(g, B, MP, T):
+    """Inverse routing for the backward: a lane's (B, T) residual
+    cotangent replicated across its MP cluster rows -> (B*MP, T), so
+    the solo _bwd_accumulate arithmetic applies unchanged."""
+    return jnp.broadcast_to(g[:, None, :], (B, MP, T)).reshape(B * MP, T)
+
+
+def _residual_planes_batch(vis_ref, mask_ref, f, v_re, v_im, B, MP, T):
+    """Per-lane masked residual d = (vis - sum_m V) * mask for
+    frequency f: 4 complex-component (d_re, d_im) (B, T) plane pairs."""
+    m = mask_ref[:, f, :]  # (B, T)
+    out = []
+    for k in range(4):
+        d_re = (vis_ref[:, f, k, :] - _lane_sum(v_re[k], B, MP, T)) * m
+        d_im = (vis_ref[:, f, 4 + k, :] - _lane_sum(v_im[k], B, MP, T)) * m
+        out.append((d_re, d_im))
+    return m, out
+
+
+def _obj_partial_batch(coh_ref, vis_ref, mask_ref, nu_ref, robust,
+                       p_re, p_im, q_re, q_im, B, F, MP, T):
+    """Per-lane partial cost (B, T) for one row tile (the batched
+    analog of _obj_partial; nu broadcasts per lane as a (B, 1) column
+    against the (B, T) residual planes)."""
+    part = jnp.zeros((B, T), jnp.float32)
+    nu = nu_ref[:, 0:1] if robust else None
+    for f in range(F):
+        c_re, c_im = _load_coh_planes(coh_ref, f)
+        v_re, v_im = _rime_products(c_re, c_im, p_re, p_im, q_re, q_im)
+        _, d = _residual_planes_batch(vis_ref, mask_ref, f, v_re, v_im,
+                                      B, MP, T)
+        for k in range(4):
+            d_re, d_im = d[k]
+            e2 = d_re * d_re + d_im * d_im
+            part = part + (jnp.log1p(e2 / nu) if robust else e2)
+    return part
+
+
+def _obj_fwd_kernel_batch(antp_ref, antq_ref, tabre_ref, tabim_ref,
+                          coh_ref, vis_ref, mask_ref, nu_ref, cost_ref,
+                          *, B, F, MP, T, robust):
+    ohp, ohq = _onehots(antp_ref, antq_ref, T)
+    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, B * MP, T)
+    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, B * MP, T)
+    # each grid step owns its own (B, tile) output block — no revisit
+    cost_ref[:, :] = _obj_partial_batch(
+        coh_ref, vis_ref, mask_ref, nu_ref, robust,
+        p_re, p_im, q_re, q_im, B, F, MP, T)
+
+
+def _g_from_residual_batch(vis_ref, mask_ref, nu_ref, robust, p_re, p_im,
+                           B, MP, T):
+    """Batched objective cotangent source: per-lane g planes (the solo
+    _g_from_residual weights, per lane) broadcast back across each
+    lane's cluster rows so _bwd_accumulate consumes (B*MP, T) planes."""
+    def g_of(f, c_re, c_im, a_re, a_im):
+        del c_re, c_im
+        v_re, v_im = _jp_a(p_re, p_im, a_re, a_im)
+        m, d = _residual_planes_batch(vis_ref, mask_ref, f, v_re, v_im,
+                                      B, MP, T)
+        g_re, g_im = [], []
+        for k in range(4):
+            d_re, d_im = d[k]
+            if robust:
+                w = 2.0 / (nu_ref[:, 0:1] + d_re * d_re + d_im * d_im)
+            else:
+                w = 2.0
+            g_re.append(_lane_bcast(-w * m * d_re, B, MP, T))
+            g_im.append(_lane_bcast(-w * m * d_im, B, MP, T))
+        return g_re, g_im
+    return g_of
+
+
+def _obj_bwd_kernel_batch(antp_ref, antq_ref, tabre_ref, tabim_ref,
+                          coh_ref, vis_ref, mask_ref, nu_ref,
+                          dtabre_ref, dtabim_ref, *, B, F, MP, T, robust):
+    ohp, ohq = _onehots(antp_ref, antq_ref, T)
+    p_re, p_im = _expand_gains(tabre_ref, tabim_ref, ohp, B * MP, T)
+    q_re, q_im = _expand_gains(tabre_ref, tabim_ref, ohq, B * MP, T)
+    g_of = _g_from_residual_batch(vis_ref, mask_ref, nu_ref, robust,
+                                  p_re, p_im, B, MP, T)
+    djp, djq = _bwd_accumulate(coh_ref, g_of, p_re, p_im, q_re, q_im,
+                               F, B * MP, T)
+    _bwd_store(dtabre_ref, dtabim_ref, djp, djq, ohp, ohq, B * MP, T)
+
+
+def _fused_cost_batch_fwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
+                               vis_ri, mask_p, nu_rows, *, robust, tile):
+    B, Mp, F, rowsp, R = _shape_args_batch(tab_re, coh_ri, vis_ri, mask_p,
+                                           tile)
+    kernel = functools.partial(_obj_fwd_kernel_batch, B=B, F=F, MP=Mp,
+                               T=tile, robust=robust)
+    part = pl.pallas_call(
+        kernel,
+        grid=(R,),
+        in_specs=[_row_spec(tile), _row_spec(tile),
+                  _tab_spec(B * Mp), _tab_spec(B * Mp),
+                  _coh_spec(B * Mp, F, tile),
+                  _bvis_spec(B, F, tile), _bmask_spec(B, F, tile),
+                  _bnu_spec(B)],
+        out_specs=pl.BlockSpec((B, tile), lambda r: (0, r),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, rowsp), jnp.float32),
+        interpret=_use_interpret(),
+    )(ant_p, ant_q, tab_re, tab_im, coh_ri, vis_ri, mask_p, nu_rows)
+    # per-lane final reduction in XLA: B*rowsp floats, not buffer-scale
+    return jnp.sum(part, axis=-1)
+
+
+def _fused_cost_batch_bwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q,
+                               vis_ri, mask_p, nu_rows, *, robust, tile):
+    B, Mp, F, rowsp, R = _shape_args_batch(tab_re, coh_ri, vis_ri, mask_p,
+                                           tile)
+    kernel = functools.partial(_obj_bwd_kernel_batch, B=B, F=F, MP=Mp,
+                               T=tile, robust=robust)
+    return pl.pallas_call(
+        kernel,
+        grid=(R,),
+        in_specs=[_row_spec(tile), _row_spec(tile),
+                  _tab_spec(B * Mp), _tab_spec(B * Mp),
+                  _coh_spec(B * Mp, F, tile),
+                  _bvis_spec(B, F, tile), _bmask_spec(B, F, tile),
+                  _bnu_spec(B)],
+        out_specs=[_tab_spec(B * Mp), _tab_spec(B * Mp)],
+        out_shape=[
+            jax.ShapeDtypeStruct((4, B * Mp, NPAD), jnp.float32),
+            jax.ShapeDtypeStruct((4, B * Mp, NPAD), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(ant_p, ant_q, tab_re, tab_im, coh_ri, vis_ri, mask_p, nu_rows)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9))
+def _fused_cost_batch(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri,
+                      mask_p, nu_rows, robust, tile):
+    return _fused_cost_batch_fwd_impl(
+        tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p, nu_rows,
+        robust=robust, tile=tile)
+
+
+def _cost_vjp_fwd_b(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p,
+                    nu_rows, robust, tile):
+    out = _fused_cost_batch_fwd_impl(
+        tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p, nu_rows,
+        robust=robust, tile=tile)
+    return out, (tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p,
+                 nu_rows)
+
+
+def _cost_vjp_bwd_b(robust, tile, res, gbar):
+    tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p, nu_rows = res
+    dre, dim = _fused_cost_batch_bwd_impl(
+        tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri, mask_p, nu_rows,
+        robust=robust, tile=tile)
+    # the kernel emits d(cost_b)/d(tab); the upstream cotangent is now
+    # PER LANE (B,) — scale each lane's Mp-row table block outside the
+    # kernel (one row-broadcast multiply, not a kernel input)
+    B = vis_ri.shape[0]
+    Mp = tab_re.shape[1] // B
+    scale = jnp.repeat(gbar, Mp)[None, :, None]  # (1, B*Mp, 1)
+    return (scale * dre, scale * dim, None, None, None, None, None, None)
+
+
+_fused_cost_batch.defvjp(_cost_vjp_fwd_b, _cost_vjp_bwd_b)
+
+
+def _nu_rows(nu, B):
+    """Per-lane nu as the batched kernel's (B, NPAD) f32 VMEM plane
+    (column-replicated).  ``nu=None`` (Gaussian) passes ones, which the
+    kernel never reads (``robust`` is static).  Scalar nu broadcasts to
+    every lane; a (B,) array carries each lane's EM mean_nu."""
+    if nu is None:
+        return jnp.ones((B, NPAD), jnp.float32)
+    nu = jnp.asarray(nu, jnp.float32)
+    return jnp.broadcast_to(nu.reshape(-1, 1) if nu.ndim else nu,
+                            (B, NPAD))
+
+
+def fused_cost_packed_batch(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri,
+                            mask_p, nu=None, tile=FULL_CLUSTER_TILE,
+                            max_rows=MAX_GRID_ROWS):
+    """Per-lane calibration objectives for a batch of lanes in ONE fused
+    grid (section comment above): returns the (B,) vector of per-lane
+    costs ``sum log1p(|((vis_b - Jp_b C_b Jq_b^H) * mask_b)|^2 / nu_b)``
+    (robust; Gaussian ``sum |...|^2`` when ``nu`` is None).
+
+    Layout: ``tab_re/tab_im`` (4, B*Mp, NPAD) lane-major batched tables
+    (:func:`pack_gain_tables_batch`); ``coh_ri`` (B*Mp, F, 8, rowsp)
+    f32 or bf16; ``ant_p/ant_q`` (1, rowsp) SHARED across lanes;
+    ``vis_ri`` (B, F, 8, rowsp); ``mask_p`` (B, F, rowsp); ``nu`` a
+    scalar or (B,) per-lane array (may be traced).  Differentiable
+    w.r.t. the tables only; the per-lane upstream cotangent is applied
+    as a row-block scale outside the kernel.  Rows beyond one Mosaic
+    grid are chunked exactly like the solo wrapper (per-chunk (B,)
+    costs summed)."""
+    B = vis_ri.shape[0]
+    rowsp = coh_ri.shape[-1]
+    plan = _chunk_plan(rowsp, tile, max_rows)
+    nu_arr = _nu_rows(nu, B)
+    robust = nu is not None
+    coh_ri = sky_constant(coh_ri)
+    if plan is None:
+        return _fused_cost_batch(
+            tab_re, tab_im, coh_ri, ant_p, ant_q,
+            jax.lax.stop_gradient(vis_ri), jax.lax.stop_gradient(mask_p),
+            nu_arr, robust, tile)
+    n, chunk = plan
+
+    def one(i):
+        c = jax.lax.dynamic_slice_in_dim(coh_ri, i * chunk, chunk, axis=3)
+        p = jax.lax.dynamic_slice_in_dim(ant_p, i * chunk, chunk, axis=1)
+        q = jax.lax.dynamic_slice_in_dim(ant_q, i * chunk, chunk, axis=1)
+        v = jax.lax.dynamic_slice_in_dim(vis_ri, i * chunk, chunk, axis=3)
+        m = jax.lax.dynamic_slice_in_dim(mask_p, i * chunk, chunk, axis=2)
+        return _fused_cost_batch(tab_re, tab_im, c, p, q,
+                                 jax.lax.stop_gradient(v),
+                                 jax.lax.stop_gradient(m), nu_arr, robust,
+                                 tile)
+
+    return jnp.sum(jax.lax.map(one, jnp.arange(n)), axis=0)
+
+
 # --------------------------------------------------- packing conveniences
 
 
@@ -1113,6 +1405,73 @@ def pack_predict_inputs(vis, mask, coh, ant_p, ant_q, chunk_map=None,
         cmap = jnp.pad(chunk_map.astype(jnp.int32),
                        ((0, mp - M), (0, pad_r)))
     return vis_ri, mask_p, coh_ri, antp, antq, cmap
+
+
+def pack_gain_tables_batch(jones_b, mp: int):
+    """(B, M, N, 2, 2) complex Jones -> lane-major component-major
+    batched tables (tab_re, tab_im) of shape (4, B*mp, NPAD) f32: lane
+    b's cluster block occupies rows [b*mp, (b+1)*mp) of every component
+    plane (nc=1 only — the batched kernel has no hybrid-chunk mode)."""
+    B, M, N = jones_b.shape[0], jones_b.shape[1], jones_b.shape[2]
+    if N > NPAD:
+        raise ValueError(
+            f"fused RIME kernel supports at most NPAD={NPAD} stations, "
+            f"got N={N}; use the XLA predict path for larger arrays"
+        )
+    flat = jones_b.reshape(B, M, N, 4)  # row-major J00, J01, J10, J11
+    tab = jnp.transpose(flat, (3, 0, 1, 2))  # (4, B, M, N)
+    tab = jnp.pad(tab, ((0, 0), (0, 0), (0, mp - M), (0, NPAD - N)))
+    tab = tab.reshape(4, B * mp, NPAD)
+    return (jnp.real(tab).astype(jnp.float32),
+            jnp.imag(tab).astype(jnp.float32))
+
+
+def pack_cost_inputs_batch(vis_b, mask_b, coh_b, ant_p, ant_q,
+                           tile=FULL_CLUSTER_TILE, max_rows=MAX_GRID_ROWS,
+                           valid=None):
+    """Pad/pack a batch of same-shape lanes into the batched objective
+    kernel's layout contract: complex ``vis_b`` (B, F, 4, rows) ->
+    ``vis_ri`` (B, F, 8, rowsp); ``mask_b`` (B, F, rows) -> ``mask_p``
+    (B, F, rowsp); complex ``coh_b`` (B, M, F, 4, rows) -> ``coh_ri``
+    (B*mp, F, 8, rowsp) lane-major; SHARED ``ant_p/ant_q`` (rows,) ->
+    (1, rowsp) int32.  ``valid`` (B,) optionally zeroes whole lanes'
+    masks — the replication-padded ragged-lane guard: a zeroed lane's
+    cost and gain cotangent are exactly 0 through the kernel (Gaussian
+    0, robust log1p(0)), so padded lanes cannot perturb the batch.
+    jnp-based: use inside jit.  Returns (vis_ri, mask_p, coh_ri, antp,
+    antq)."""
+    B, M, rows = coh_b.shape[0], coh_b.shape[1], coh_b.shape[-1]
+    mp = pad_to(M, 8)
+    rowsp = chunked_rowsp(rows, tile, max_rows)
+    pad_r = rowsp - rows
+    coh_ri = jnp.concatenate(
+        [jnp.real(coh_b), jnp.imag(coh_b)], axis=-2
+    ).astype(jnp.float32)
+    coh_ri = jnp.pad(
+        coh_ri, ((0, 0), (0, mp - M), (0, 0), (0, 0), (0, pad_r))
+    ).reshape(B * mp, coh_b.shape[2], 8, rowsp)
+    vis_ri = jnp.concatenate(
+        [jnp.real(vis_b), jnp.imag(vis_b)], axis=-2
+    ).astype(jnp.float32)
+    vis_ri = jnp.pad(vis_ri, ((0, 0), (0, 0), (0, 0), (0, pad_r)))
+    mask_p = jnp.pad(mask_b.astype(jnp.float32),
+                     ((0, 0), (0, 0), (0, pad_r)))
+    if valid is not None:
+        mask_p = mask_p * jnp.asarray(valid, jnp.float32)[:, None, None]
+    antp = jnp.pad(ant_p.astype(jnp.int32)[None, :], ((0, 0), (0, pad_r)))
+    antq = jnp.pad(ant_q.astype(jnp.int32)[None, :], ((0, 0), (0, pad_r)))
+    return vis_ri, mask_p, coh_ri, antp, antq
+
+
+def unpack_gain_grads_batch(dre, dim, B: int, M: int, N: int):
+    """Inverse of :func:`pack_gain_tables_batch` for cotangents:
+    (4, B*mp, NPAD) pair -> (B, M, N, 2, 2) re/im arrays."""
+    mp = dre.shape[1] // B
+    out = []
+    for d in (dre, dim):
+        d = d.reshape(4, B, mp, NPAD)[:, :, :M, :N]
+        out.append(jnp.transpose(d, (1, 2, 3, 0)).reshape(B, M, N, 2, 2))
+    return out[0], out[1]
 
 
 def unpack_gain_grads(dre, dim, M: int, N: int):

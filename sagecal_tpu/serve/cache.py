@@ -60,9 +60,10 @@ class _AOTExecutable:
     falls back to a fresh instrumented jit — slower (one compile) but
     never wrong."""
 
-    def __init__(self, compiled, name: str):
+    def __init__(self, compiled, name: str, batched_fused: bool = False):
         self._compiled = compiled
         self.name = name
+        self.batched_fused = batched_fused
         self._fallback: Optional[Callable] = None
 
     def __call__(self, *args):
@@ -72,12 +73,26 @@ class _AOTExecutable:
             return self._compiled(*args)
         except Exception:
             from sagecal_tpu.obs.perf import instrumented_jit
-            from sagecal_tpu.solvers.batched import sagefit_packed_batch
 
             self._fallback = instrumented_jit(
-                sagefit_packed_batch, name=self.name,
+                _solve_fn(self.batched_fused), name=self.name,
                 donate_argnames=("p0",))
             return self._fallback(*args)
+
+
+def _solve_fn(batched_fused: bool) -> Callable:
+    """The batched-solve entry with the kernel path BAKED IN: the
+    ``batched_fused`` flag is compile-time static (it selects between
+    the batched fused Pallas grid and the vmapped paths), so each cache
+    entry closes over its routing decision instead of threading a
+    static argument through jit/AOT signatures."""
+    import functools
+
+    from sagecal_tpu.solvers.batched import sagefit_packed_batch
+
+    if not batched_fused:
+        return sagefit_packed_batch
+    return functools.partial(sagefit_packed_batch, batched_fused=True)
 
 
 class ExecutableCache:
@@ -100,7 +115,8 @@ class ExecutableCache:
         return self.get_with_status(bucket, fingerprint)[0]
 
     def get_with_status(self, bucket: BucketSpec, fingerprint: str,
-                        example_args: Optional[tuple] = None
+                        example_args: Optional[tuple] = None,
+                        batched_fused: bool = False,
                         ) -> Tuple[Callable, bool]:
         """Like :meth:`get` but also reports whether the lookup avoided
         a compile (``(fn, True)``) or must compile (``(fn, False)``) —
@@ -108,7 +124,12 @@ class ExecutableCache:
         ``compile`` off this bit.  A store LOAD reports True: the
         request never waits on a compiler.  ``example_args`` (the
         actual batch arguments) enables the store tier — without them
-        the cache can only hand back a lazy jit wrapper."""
+        the cache can only hand back a lazy jit wrapper.
+        ``batched_fused`` selects the kernel path baked into a NEW
+        entry (:func:`_solve_fn`); it must be deterministic per
+        (bucket, fingerprint) — :func:`sagecal_tpu.solvers.batched.
+        choose_batched_path` is, because every input to its decision is
+        part of the bucket or the fingerprint."""
         key = (bucket, fingerprint)
         with self._lock:
             fn = self._entries.get(key)
@@ -120,9 +141,10 @@ class ExecutableCache:
             self._count("misses", bucket)
             if self.store is not None and example_args is not None:
                 fn, hit = self._from_store(bucket, fingerprint,
-                                           example_args)
+                                           example_args, batched_fused)
             else:
-                fn, hit = self._lazy_jit(bucket, fingerprint), False
+                fn, hit = self._lazy_jit(bucket, fingerprint,
+                                         batched_fused), False
             self._entries[key] = fn
             return fn, hit
 
@@ -134,30 +156,31 @@ class ExecutableCache:
         # the shape class that paid it
         return f"serve_batch[{bucket.short()}#{fingerprint[:8]}]"
 
-    def _lazy_jit(self, bucket: BucketSpec, fingerprint: str) -> Callable:
+    def _lazy_jit(self, bucket: BucketSpec, fingerprint: str,
+                  batched_fused: bool = False) -> Callable:
         from sagecal_tpu.obs.perf import instrumented_jit
-        from sagecal_tpu.solvers.batched import sagefit_packed_batch
 
         return instrumented_jit(
-            sagefit_packed_batch,
+            _solve_fn(batched_fused),
             name=self.entry_name(bucket, fingerprint),
             donate_argnames=("p0",),
         )
 
     def _from_store(self, bucket: BucketSpec, fingerprint: str,
-                    example_args: tuple) -> Tuple[Callable, bool]:
+                    example_args: tuple, batched_fused: bool = False
+                    ) -> Tuple[Callable, bool]:
         """Store tier: load (zero compiles) or compile-and-save."""
         import jax
 
         from sagecal_tpu.obs.perf import note_compile
-        from sagecal_tpu.solvers.batched import sagefit_packed_batch
 
         batch_w = int(example_args[6].shape[0])  # p0 leading axis
         name = self.entry_name(bucket, fingerprint)
         loaded = self.store.load(bucket, fingerprint, batch_w)
         if loaded is not None:
-            return _AOTExecutable(loaded, name), True
-        jitted = jax.jit(sagefit_packed_batch, donate_argnames=("p0",))
+            return _AOTExecutable(loaded, name, batched_fused), True
+        jitted = jax.jit(_solve_fn(batched_fused),
+                         donate_argnames=("p0",))
         t0 = time.perf_counter()
         lowered = jitted.lower(*example_args)
         t1 = time.perf_counter()
@@ -173,7 +196,7 @@ class ExecutableCache:
         note_compile(name, t1 - t0, t2 - t1, flops, by, aot=True)
         self._count("compiles", bucket)
         self.store.save(bucket, fingerprint, batch_w, compiled)
-        return _AOTExecutable(compiled, name), False
+        return _AOTExecutable(compiled, name, batched_fused), False
 
     def _count(self, kind: str, bucket: BucketSpec) -> None:
         try:

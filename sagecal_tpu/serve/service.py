@@ -66,12 +66,21 @@ def _merge_sage_config(cfg, req: SolveRequest):
         randomize=(cfg.randomize if req.randomize is None
                    else req.randomize),
     )
+    # fused-kernel routing is service-wide, f32-only (the fullbatch
+    # precedent: a fused request under use_f64 silently stays on XLA)
+    use_fused = getattr(cfg, "use_fused_predict", False) \
+        and not cfg.use_f64
+    coh_dtype = getattr(cfg, "coh_dtype", "f32")
     scfg = SageConfig(
         collect_telemetry=False,  # batched lanes report via quality
         collect_quality=True,     # per-request verdicts are the product
+        use_fused_predict=use_fused,
+        coh_dtype=coh_dtype,
         **knobs,
     )
     fp = config_fingerprint(use_f64=cfg.use_f64,
+                            use_fused_predict=use_fused,
+                            coh_dtype=coh_dtype,
                             collect=telemetry_enabled(), **knobs)
     return scfg, fp
 
@@ -220,7 +229,8 @@ class CalibrationService:
     def _load_entry(self, req: SolveRequest, data, meta) -> _Entry:
         """Tile data (already prefetched) -> solve-ready entry:
         coherencies, identity gains carry, per-request RNG key."""
-        import jax
+        import zlib
+
         import jax.numpy as jnp
 
         from sagecal_tpu.core.types import identity_jones, jones_to_params
@@ -240,11 +250,16 @@ class CalibrationService:
         p0 = np.asarray(
             jnp.broadcast_to(eye, (M, nchunk_max, 8 * N)).astype(dtype))
         scfg, fp = _merge_sage_config(self.cfg, req)
-        # per-request key derived from the request id: deterministic
-        # across restarts, independent across lanes
-        seed = int.from_bytes(req.request_id.encode()[:4].ljust(4, b"\0"),
-                              "little")
-        key = np.asarray(jax.random.PRNGKey(seed))
+        # per-request key derived from the FULL request identity via the
+        # shared batched-solver helper — a pure function of the request,
+        # so the randomized solver stream reproduces across restarts,
+        # schedulers and batch slots (the old 4-byte-prefix seed
+        # collided for ids sharing a prefix, and re-deriving per
+        # submission made robust solves scheduler-dependent)
+        from sagecal_tpu.solvers.batched import derive_lane_keys
+
+        lane_id = zlib.crc32(req.request_id.encode())
+        key = np.asarray(derive_lane_keys(0, [lane_id])[0])
         entry = _Entry(req, data, cdata, p0, key, scfg, meta, M,
                        nchunk_max)
         return entry, fp
@@ -275,8 +290,19 @@ class CalibrationService:
         keys = np.stack([entries[i].key for i in idx])
         scfg = entries[0].scfg
 
+        # kernel-path capability check on the CONCRETE stacked batch
+        # (host numpy): one Pallas grid for the whole batch when it
+        # passes, vmapped solo kernels / XLA otherwise.  Deterministic
+        # per (bucket, fingerprint), so the executable-cache entry and
+        # the static batched_fused flag always agree.
+        from sagecal_tpu.solvers.batched import choose_batched_path
+
+        kernel_path, path_reason = choose_batched_path(
+            data_b, cdata_b, p0, scfg)
+        batched_fused = kernel_path == "fused_batch"
+
         args = (data_b, cdata_b, vis.real, vis.imag, coh.real, coh.imag,
-                p0, scfg, keys)
+                p0, scfg, keys, np.asarray(valid, bool))
         if self.device is not None:
             args = jax.device_put(args, self.device)
         pack_s = time.time() - t_pack
@@ -289,7 +315,8 @@ class CalibrationService:
         compile_before = self._compile_seconds_by_name(name)
         tic = time.time()
         fn, cache_hit = self.cache.get_with_status(
-            bucket, fingerprint, example_args=args)
+            bucket, fingerprint, example_args=args,
+            batched_fused=batched_fused)
         out = fn(*args)
         # materialize on host before unpacking lanes (one sync)
         p_host = np.asarray(out.p)
@@ -310,6 +337,8 @@ class CalibrationService:
                       fingerprint=fingerprint[:12], size=k,
                       batch=len(idx), padded=padded_flush,
                       seconds=solve_s,
+                      kernel_path=kernel_path,
+                      kernel_path_reason=path_reason,
                       cache=self.cache.stats())
         # unpack over the FULL batch width with an explicit validity
         # guard: replication-padded lanes (valid[lane] is False) carry

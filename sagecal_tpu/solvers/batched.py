@@ -37,6 +37,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from sagecal_tpu.core.types import VisData
 from sagecal_tpu.obs.perf import instrumented_jit
 from sagecal_tpu.solvers.batchmode import bfgsfit_minibatch
@@ -45,14 +47,77 @@ from sagecal_tpu.solvers.sage import (
     ClusterData,
     SageConfig,
     SageResult,
+    sagefit_batched_fused,
     sagefit_packed,
 )
+
+# VMEM ceiling of the batched fused BACKWARD kernel: its in-register
+# accumulators are sixteen (B*Mp, tile) f32 planes, so B*Mp is bounded
+# exactly like the solo kernel's padded cluster count at tile 128 (the
+# hardware-proven FULL_CLUSTER_TILE configuration — ops/rime_kernel.py
+# batched section comment).
+_BATCH_ROWS_MAX = 104
 
 
 def _batch_axes(tree):
     """An ``in_axes`` pytree mapping every array leaf of ``tree`` to
     axis 0 (None leaves — the stripped complex slots — stay None)."""
     return jax.tree_util.tree_map(lambda _: 0, tree)
+
+
+def derive_lane_keys(seed: int, lane_ids) -> jax.Array:
+    """Stable per-lane PRNG keys from lane IDENTITIES, not submission
+    order: ``key_i = fold_in(PRNGKey(seed), lane_ids[i])``.
+
+    Hoisted out of the dispatch loop (the serve layer used to re-split a
+    fresh key per submission) so a request's randomized solver stream —
+    OS-LM subset draws, robust nu estimation order — is a function of
+    the request itself and reproduces identically whichever scheduler,
+    worker or batch slot executes it."""
+    base = jax.random.PRNGKey(seed)
+    ids = jnp.asarray(np.asarray(lane_ids), jnp.uint32)
+    return jax.vmap(lambda i: jax.random.fold_in(base, i))(ids)
+
+
+def choose_batched_path(data, cdata, p0, config: SageConfig):
+    """Host-side capability check routing a batch to the best kernel
+    path — the batched analog of the solo chunked-fallback machinery.
+
+    Returns ``(path, reason)`` with path one of:
+
+    - ``"fused_batch"`` — one Pallas grid for the whole batch
+      (:func:`sagecal_tpu.solvers.sage.sagefit_batched_fused`);
+    - ``"fused"`` — vmapped solo fused kernels (capability shortfall is
+      batch-specific: hybrid chunks, unshared baselines, VMEM bound);
+    - ``"xla"`` — vmapped XLA predict (fused path disabled or unusable).
+
+    All checks are CONCRETE (host numpy) — call before jit dispatch.
+    ``data``/``cdata`` leaves carry the leading batch axis; ``p0`` is
+    (B, M, nchunk_max, 8N)."""
+    from sagecal_tpu.ops.rime_kernel import NPAD, pad_to
+
+    if not config.use_fused_predict:
+        return "xla", "fused predict disabled in config"
+    B, M, nchunk_max, n8 = p0.shape
+    if np.asarray(p0).dtype != np.float32:
+        return "xla", "fused kernels require float32 parameters/data"
+    if n8 // 8 > NPAD:
+        return "xla", f"N={n8 // 8} exceeds the kernel's NPAD={NPAD}"
+    if config.param_bound > 0.0:
+        return "xla", "param_bound uses the (XLA-only) bounded LBFGS"
+    if config.collect_telemetry:
+        return "xla", "telemetry traces are XLA-path only"
+    if nchunk_max > 1:
+        return "fused", "hybrid time chunks: batched kernel is nc==1 only"
+    ant_p = np.asarray(data.ant_p)
+    ant_q = np.asarray(data.ant_q)
+    if not (np.all(ant_p == ant_p[:1]) and np.all(ant_q == ant_q[:1])):
+        return "fused", "lanes do not share baseline geometry"
+    if B * pad_to(M, 8) > _BATCH_ROWS_MAX:
+        return "fused", (
+            f"B*Mp={B * pad_to(M, 8)} exceeds the backward kernel's "
+            f"VMEM accumulator bound ({_BATCH_ROWS_MAX})")
+    return "fused_batch", "all batched-kernel capability checks passed"
 
 
 def sagefit_packed_batch(
@@ -65,6 +130,8 @@ def sagefit_packed_batch(
     p0: jax.Array,
     config: SageConfig = SageConfig(),
     keys: Optional[jax.Array] = None,
+    valid: Optional[jax.Array] = None,
+    batched_fused: bool = False,
 ) -> SageResult:
     """``B`` independent tile solves as one vmapped device program.
 
@@ -72,11 +139,27 @@ def sagefit_packed_batch(
     leading batch axis on every array: ``vis_*`` is ``(B, F, 4, rows)``,
     ``coh_*`` is ``(B, M, F, 4, rows)``, ``p0`` is
     ``(B, M, nchunk_max, 8N)`` and ``keys`` is ``(B, 2)`` (one PRNG key
-    per lane, so randomized OS subsets stay independent per request).
+    per lane, so randomized OS subsets stay independent per request;
+    derive them from request identity with :func:`derive_lane_keys`).
     Returns a :class:`SageResult` whose leaves all carry the batch axis.
+
+    ``batched_fused`` (STATIC; set it from :func:`choose_batched_path`)
+    routes the joint-LBFGS phase through the batched fused Pallas kernel
+    (:func:`sagecal_tpu.solvers.sage.sagefit_batched_fused`) instead of
+    vmapping B solo solves; ``valid`` (B,) then pins replication-padded
+    lanes to exactly zero cost/cotangent in that phase.  On the vmapped
+    paths ``valid`` is ignored — padded lanes run replicated finite
+    solves whose results the host discards, as before.
     """
     if keys is None:
         keys = jax.random.split(jax.random.PRNGKey(0), vis_re.shape[0])
+    if batched_fused:
+        vis = jax.lax.complex(vis_re, vis_im)
+        coh = jax.lax.complex(coh_re, coh_im)
+        return sagefit_batched_fused(
+            data.replace(vis=vis), cdata._replace(coh=coh), p0, config,
+            keys, valid,
+        )
 
     def one(d, cd, vr, vi, cr, ci, p, k):
         return sagefit_packed(d, cd, vr, vi, cr, ci, p, config, k)
@@ -94,6 +177,7 @@ def sagefit_packed_batch(
 # donated, exactly like the single-solve entry's p0.
 sagefit_packed_batch_jit = instrumented_jit(
     sagefit_packed_batch, name="sagefit_packed_batch",
+    static_argnames=("batched_fused",),
     donate_argnames=("p0",))
 
 

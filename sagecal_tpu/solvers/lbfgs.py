@@ -331,6 +331,252 @@ def lbfgs_fit(
                        iterations=ck, trace=trace)
 
 
+def _bdot(a, b):
+    """Per-lane dot: (B, n) x (B, n) -> (B,)."""
+    return jnp.einsum("bn,bn->b", a, b)
+
+
+def _bnorm(a):
+    return jnp.sqrt(_bdot(a, a))
+
+
+def _bexpand(mask, leaf):
+    """(B,) predicate broadcast against a (B, ...) carry leaf."""
+    return mask.reshape((mask.shape[0],) + (1,) * (leaf.ndim - 1))
+
+
+def batched_memory(B: int, n: int, M: int = 7,
+                   dtype=jnp.float32) -> LBFGSMemory:
+    """Fresh :class:`LBFGSMemory` with every leaf carrying a leading
+    batch axis ``B`` — the per-lane curvature store of
+    :func:`lbfgs_fit_batched`."""
+    one = LBFGSMemory.init(n, M, dtype)
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (B,) + x.shape), one)
+
+
+def _two_loop_direction_batched(g: jax.Array, mem: LBFGSMemory) -> jax.Array:
+    """Per-lane -H_k g: the two-loop recursion of
+    :func:`_two_loop_direction` with a leading batch axis on g (B, n)
+    and on every memory leaf.  Per-lane circular indexing is a
+    take_along_axis gather; the scan runs over the M slot axis with all
+    lanes in lock-step (exactly what vmap of the solo recursion
+    builds)."""
+    Bsz, Mslots, _ = mem.s.shape
+    k = jnp.arange(Mslots)
+    newest_first = jnp.mod(mem.vacant[:, None] - 1 - k[None, :], Mslots)
+    valid = k[None, :] < mem.nfilled[:, None]  # (B, M) newest-first
+    s = jnp.take_along_axis(mem.s, newest_first[:, :, None], axis=1)
+    y = jnp.take_along_axis(mem.y, newest_first[:, :, None], axis=1)
+    rho = jnp.take_along_axis(mem.rho, newest_first, axis=1)
+
+    def loop1(q, inp):
+        s_i, y_i, rho_i, ok = inp  # (B, n), (B, n), (B,), (B,)
+        alpha_i = jnp.where(ok, rho_i * _bdot(s_i, q), 0.0)
+        return q - alpha_i[:, None] * y_i, alpha_i
+
+    q, alphas = jax.lax.scan(
+        loop1, g, (s.swapaxes(0, 1), y.swapaxes(0, 1), rho.T, valid.T))
+    y0, s0 = y[:, 0], s[:, 0]
+    yy = _bdot(y0, y0)
+    gamma = jnp.where(
+        (mem.nfilled > 0) & (yy > 0.0),
+        _bdot(s0, y0) / jnp.maximum(yy, 1e-30), 1.0)
+    r = gamma[:, None] * q
+
+    def loop2(r, inp):
+        s_i, y_i, rho_i, alpha_i, ok = inp
+        beta = jnp.where(ok, rho_i * _bdot(y_i, r), 0.0)
+        return r + s_i * jnp.where(ok, alpha_i - beta, 0.0)[:, None], None
+
+    r, _ = jax.lax.scan(
+        loop2, r,
+        (s[:, ::-1].swapaxes(0, 1), y[:, ::-1].swapaxes(0, 1),
+         rho[:, ::-1].T, alphas[::-1], valid[:, ::-1].T))
+    return -r
+
+
+def _armijo_rest_batched(cost_fn, x, p, a0, fold, f_a0, product, live):
+    """Per-lane Armijo halving (vmap semantics of :func:`_armijo_rest`):
+    each lane halves while ITS OWN test fails, frozen once it passes;
+    the loop runs until no live lane is still failing.  ``live`` masks
+    out lanes that already accepted the first trial (or finished the
+    outer loop) so a pathological frozen lane cannot spin the batch."""
+
+    def bad(ci, alpha, fnew):
+        return live & (ci < 15) & _armijo_bad(fnew, fold, alpha, product)
+
+    def cond(st):
+        ci, alpha, fnew = st
+        return jnp.any(bad(ci, alpha, fnew))
+
+    def body(st):
+        ci, alpha, fnew = st
+        b = bad(ci, alpha, fnew)
+        alpha1 = jnp.where(b, alpha * 0.5, alpha)
+        f1 = cost_fn(x + alpha1[:, None] * p)
+        return (jnp.where(b, ci + 1, ci), alpha1,
+                jnp.where(b, f1, fnew))
+
+    ci, alpha, _ = jax.lax.while_loop(
+        cond, body, (jnp.zeros(a0.shape, jnp.int32), a0, f_a0))
+    return alpha, ci
+
+
+@true_f32
+def lbfgs_fit_batched(
+    cost_fn: Callable,
+    p0: jax.Array,
+    itmax: int = 50,
+    M: int = 7,
+    memory: Optional[LBFGSMemory] = None,
+    minibatch: bool = False,
+    vg_fn: Optional[Callable] = None,
+) -> LBFGSResult:
+    """``B`` independent LBFGS fits advancing in lock-step so EVERY cost
+    and gradient evaluation is ONE batched call — the driver for the
+    batched fused objective kernel (``ops.rime_kernel.
+    fused_cost_packed_batch``), where a vmap of :func:`lbfgs_fit` would
+    fall back to B solo kernel dispatches.
+
+    ``cost_fn``: (B, n) -> (B,) per-lane costs; lanes MUST be
+    independent (lane b's cost depends only on row b — that is what
+    makes the default pullback-of-ones gradient per-lane exact).
+    ``p0``: (B, n).  ``memory``: per-lane :class:`LBFGSMemory`
+    (leading B on every leaf, see :func:`batched_memory`).
+
+    Per-lane semantics match ``jax.vmap(lbfgs_fit)`` (same predicates,
+    same masked-carry advancement — a lane whose own termination fires
+    freezes while the others run), but not bit-identically: batched
+    reductions re-associate, like the rest of the serve batch path.
+    Telemetry traces are not collected on the batched path."""
+    B, n = p0.shape
+    if vg_fn is None:
+        def vg_fn(x):
+            costs, pull = jax.vjp(cost_fn, x)
+            (g,) = pull(jnp.ones_like(costs))
+            return costs, g
+    if memory is None:
+        memory = batched_memory(B, n, M, p0.dtype)
+
+    f0, g0 = vg_fn(p0)
+    gradnrm0 = _bnorm(g0)
+
+    if minibatch:
+        batch_changed = memory.niter > 0  # (B,)
+        niter1 = memory.niter + 1
+
+        def upd(mem):
+            g_min_rold = g0 - mem.running_avg
+            ravg = (mem.running_avg
+                    + g_min_rold / niter1.astype(p0.dtype)[:, None])
+            g_min_rnew = g0 - ravg
+            ravg_sq = mem.running_avg_sq + g_min_rold * g_min_rnew
+            return mem.replace(running_avg=ravg, running_avg_sq=ravg_sq)
+
+        memory = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(_bexpand(batch_changed, a), a, b),
+            upd(memory), memory)
+        alphabar = jnp.where(
+            batch_changed,
+            10.0 / (
+                1.0
+                + jnp.sum(jnp.abs(memory.running_avg_sq), axis=-1)
+                / (jnp.maximum(memory.niter, 1).astype(p0.dtype)
+                   * jnp.maximum(gradnrm0, 1e-30))
+            ),
+            1.0,
+        )
+    else:
+        batch_changed = jnp.zeros((B,), bool)
+        alphabar = jnp.ones((B,), p0.dtype)
+
+    def cond(state):
+        ck, x, f, g, gradnrm, mem, done = state
+        return jnp.any((ck < itmax) & (~done))
+
+    def body(state):
+        ck, x, f, g, gradnrm, mem, done = state
+        active = (ck < itmax) & (~done)
+        pk = _two_loop_direction_batched(g, mem)
+        a0 = jnp.asarray(alphabar, x.dtype)
+        x_t = x + a0[:, None] * pk
+        f_t, g_t = vg_fn(x_t)
+        product = ARMIJO_C * _bdot(pk, g)
+        first_ok = ~_armijo_bad(f_t, f, a0, product)
+        need_bt = active & ~first_ok
+
+        def accept_all(_):
+            return a0, f_t, g_t, jnp.ones((B,), x.dtype)
+
+        def backtrack_some(_):
+            alpha, halvings = _armijo_rest_batched(
+                cost_fn, x, pk, a0, f, f_t, product, need_bt)
+            fb, gb = vg_fn(x + alpha[:, None] * pk)
+            f1 = jnp.where(need_bt, fb, f_t)
+            g1 = jnp.where(need_bt[:, None], gb, g_t)
+            evals = jnp.where(need_bt, 2.0 + halvings.astype(x.dtype),
+                              1.0)
+            return alpha, f1, g1, evals
+
+        # one REAL branch (traced-scalar cond): the all-accept common
+        # case costs exactly one fused (f, g) pass, like the solo path
+        alphak, f1, g1, _ = jax.lax.cond(
+            jnp.any(need_bt), backtrack_some, accept_all, None)
+        step_ok = jnp.isfinite(alphak) & (jnp.abs(alphak) >= CLM_EPSILON)
+        x1 = x + alphak[:, None] * pk
+        gradnrm1 = _bnorm(g1)
+        grad_ok = jnp.isfinite(gradnrm1) & (gradnrm1 > CLM_STOP_THRESH)
+
+        store = step_ok & ~(batch_changed & (ck == 0))
+        sk = x1 - x
+        yk = g1 - g
+        yk = yk + jnp.where(gradnrm1 > 1e-3, 1e-6, 0.0)[:, None] * sk
+        ys = _bdot(yk, sk)
+        curv_eps = jnp.finfo(yk.dtype).eps
+        curv_ok = ys > curv_eps * _bnorm(yk) * _bnorm(sk)
+        store = store & curv_ok
+        rho_k = jnp.where(curv_ok, 1.0 / jnp.maximum(ys, 1e-38), 0.0)
+        slot = mem.vacant  # (B,)
+        bidx = jnp.arange(B)
+
+        def do_store(mem):
+            return mem.replace(
+                s=mem.s.at[bidx, slot].set(sk),
+                y=mem.y.at[bidx, slot].set(yk),
+                rho=mem.rho.at[bidx, slot].set(rho_k),
+                vacant=jnp.mod(slot + 1, mem.s.shape[1]),
+                nfilled=jnp.minimum(mem.nfilled + 1, mem.s.shape[1]),
+            )
+
+        mem1 = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(_bexpand(store, a), a, b),
+            do_store(mem), mem)
+        mem1 = mem1.replace(niter=mem.niter + 1)
+        # frozen lanes keep their whole carry (the vmap-of-while mask)
+        mem_next = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(_bexpand(active, a), a, b), mem1, mem)
+        adv = active & step_ok
+        x_next = jnp.where(adv[:, None], x1, x)
+        f_next = jnp.where(adv, f1, f)
+        g_next = jnp.where(adv[:, None], g1, g)
+        gradnrm_next = jnp.where(adv, gradnrm1, gradnrm)
+        done_next = jnp.where(active, (~step_ok) | (~grad_ok), done)
+        return (jnp.where(active, ck + 1, ck), x_next, f_next, g_next,
+                gradnrm_next, mem_next, done_next)
+
+    from sagecal_tpu.utils.platform import match_vma
+
+    start_done = ~(jnp.isfinite(gradnrm0) & (gradnrm0 > CLM_STOP_THRESH))
+    ck, x, f, g, gradnrm, mem, _ = jax.lax.while_loop(
+        cond, body,
+        match_vma((jnp.zeros((B,), jnp.int32), p0, f0, g0, gradnrm0,
+                   memory, start_done), p0),
+    )
+    return LBFGSResult(p=x, memory=mem, cost=f, gradnorm=gradnrm,
+                       iterations=ck, trace=None)
+
+
 # jitted module entry with compile/recompile telemetry (obs/perf.py):
 # cost_fn/grad_fn are static (hashed by identity — a new closure is a
 # new signature), as are the compile-time loop bounds

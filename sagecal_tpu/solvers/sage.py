@@ -523,20 +523,70 @@ def _make_fused_joint_cost(data, cdata, M, nchunk_max, n8, robust, mean_nu,
     return cost_fn
 
 
-@true_f32
-def sagefit(
+def _make_fused_joint_cost_batch(data, cdata, B, M, n8, robust, mean_nu_b,
+                                 coh_dtype="f32", valid=None):
+    """Batched joint-LBFGS cost: the fused objective for B lanes in ONE
+    Pallas grid (``ops.rime_kernel.fused_cost_packed_batch``), the lane
+    axis folded into the MXU contraction.  ``data``/``cdata`` leaves
+    carry a leading batch axis; all lanes must share ``ant_p``/``ant_q``
+    (checked host-side by the router) — the kernel reads lane 0's copy.
+    ``mean_nu_b``: (B,) per-lane Student's-t nu (traced; EM refinements
+    never recompile).  ``valid``: optional (B,) lane mask zeroing padded
+    lanes' cost and cotangent (pack_cost_inputs_batch docstring).
+    nchunk_max == 1 only; f32 data only; ``coh_dtype="bf16"`` halves the
+    dominant coherency HBM stream with f32 accumulation."""
+    from sagecal_tpu.ops.rime_kernel import (
+        FULL_CLUSTER_TILE, MAX_GRID_ROWS, fused_cost_packed_batch,
+        pack_cost_inputs_batch, pack_gain_tables_batch, pad_to,
+    )
+
+    if jnp.real(data.vis).dtype != jnp.float32:
+        raise ValueError(
+            "the batched fused path requires float32 data (the Pallas "
+            "kernel computes in f32); run with f64 disabled or use the "
+            "XLA path"
+        )
+    if coh_dtype not in ("f32", "bf16"):
+        raise ValueError(f"coh_dtype must be 'f32' or 'bf16', got "
+                         f"{coh_dtype!r}")
+    mp = pad_to(M, 8)
+    vis_ri, mask_p, coh_ri, antp, antq = pack_cost_inputs_batch(
+        data.vis, data.mask, cdata.coh, data.ant_p[0], data.ant_q[0],
+        FULL_CLUSTER_TILE, max_rows=MAX_GRID_ROWS, valid=valid,
+    )
+    if coh_dtype == "bf16":
+        coh_ri = coh_ri.astype(jnp.bfloat16)
+    coh_c = jax.lax.stop_gradient(coh_ri)
+    nu_c = mean_nu_b if robust else None
+
+    def cost_fn(pflat_b):
+        # (B, M*8N) -> (B,) per-lane costs, one grid for the whole batch
+        jones = params_to_jones(
+            pflat_b.reshape(B, M, n8).astype(jnp.float32)
+        )  # (B, M, N, 2, 2)
+        tre, tim = pack_gain_tables_batch(jones, mp)
+        return fused_cost_packed_batch(
+            tre, tim, coh_c, antp, antq, vis_ri, mask_p, nu_c,
+            FULL_CLUSTER_TILE, MAX_GRID_ROWS,
+        )
+
+    return cost_fn
+
+
+def _em_phase(
     data: VisData,
     cdata: ClusterData,
     p0: jax.Array,
-    config: SageConfig = SageConfig(),
-    key: Optional[jax.Array] = None,
-) -> SageResult:
-    """One tile's SAGE calibration.  ``p0``: (M, nchunk_max, 8N)."""
-    if key is None:
-        key = jax.random.PRNGKey(0)
+    config: SageConfig,
+    key: jax.Array,
+):
+    """The SAGE expectation passes of :func:`sagefit` — per-cluster
+    solves and nu estimation, NO joint LBFGS and no finalization.
+    Returns ``(p, mean_nu, res_0, em_traces, em_quality)``.  Factored
+    out so :func:`sagefit_batched_fused` can vmap the per-cluster EM
+    machinery per lane while replacing the joint-LBFGS phase with one
+    batched fused kernel loop."""
     M = cdata.coh.shape[0]
-    nchunk_max = p0.shape[1]
-    n8 = p0.shape[2]
     F, rows = data.vis.shape[-3], data.vis.shape[-1]
     nreal = rows * F * 8
     mode = config.solver_mode
@@ -687,6 +737,87 @@ def sagefit(
         if config.randomize:
             weighted = ~weighted
     mean_nu = jnp.clip(jnp.mean(nus), config.nulow, config.nuhigh)
+    return p, mean_nu, res_0, em_traces, em_quality
+
+
+def _finalize(
+    data: VisData,
+    cdata: ClusterData,
+    p: jax.Array,
+    res_0: jax.Array,
+    mean_nu: jax.Array,
+    config: SageConfig,
+    lbfgs_trace,
+    em_traces,
+    em_quality,
+) -> SageResult:
+    """Final full-model residual plus telemetry/quality bundling — the
+    tail of :func:`sagefit` after the joint LBFGS, shared with the
+    batched fused driver (vmapped per lane there)."""
+    robust = config.solver_mode in _ROBUST_MODES
+    collect = config.collect_telemetry
+    collect_q = config.collect_quality
+    F, rows = data.vis.shape[-3], data.vis.shape[-1]
+    nreal = rows * F * 8
+    n8 = p.shape[2]
+
+    full1 = predict_full_model(p, cdata, data)
+    res_1 = _res_norm(data.vis - full1, data.mask, nreal)
+    telemetry = (
+        {"em": tuple(em_traces), "lbfgs": lbfgs_trace} if collect else None
+    )
+    quality = None
+    if collect_q:
+        # whole-solution bundle: chi^2 of the FULL residual (all cluster
+        # models subtracted) attributed per station/baseline, plus gain
+        # health over every (cluster, chunk) lane.  No hybrid-chunk
+        # structure exists for the joint residual, so chi2_chunk is the
+        # single total.
+        from sagecal_tpu.core.types import reals_of_flat
+        from sagecal_tpu.ops.quality import (
+            SolveQuality, chi2_scatter, gain_health, row_chi2,
+        )
+
+        e = reals_of_flat((data.vis - full1) * data.mask[..., None, :])
+        row = row_chi2(e)
+        chi2_st, chi2_bl, chi2_ch = chi2_scatter(
+            row, data.ant_p, data.ant_q, jnp.zeros_like(data.ant_p),
+            n8 // 8, 1,
+        )
+        nonfinite, amp, amp_sp, ph_sp, dep = gain_health(p)
+        final_q = SolveQuality(
+            chi2_station=chi2_st, chi2_baseline=chi2_bl,
+            chi2_chunk=chi2_ch, nonfinite_count=nonfinite,
+            station_amp=amp, station_amp_spread=amp_sp,
+            station_phase_spread=ph_sp, identity_departure=dep,
+            nu=mean_nu if robust else None,
+        )
+        quality = {"em": em_quality, "final": final_q}
+    return SageResult(
+        p=p, res_0=res_0, res_1=res_1, mean_nu=mean_nu,
+        diverged=res_1 > res_0, telemetry=telemetry, quality=quality,
+    )
+
+
+@true_f32
+def sagefit(
+    data: VisData,
+    cdata: ClusterData,
+    p0: jax.Array,
+    config: SageConfig = SageConfig(),
+    key: Optional[jax.Array] = None,
+) -> SageResult:
+    """One tile's SAGE calibration.  ``p0``: (M, nchunk_max, 8N)."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    M = cdata.coh.shape[0]
+    nchunk_max = p0.shape[1]
+    n8 = p0.shape[2]
+    robust = config.solver_mode in _ROBUST_MODES
+    collect = config.collect_telemetry
+
+    p, mean_nu, res_0, em_traces, em_quality = _em_phase(
+        data, cdata, p0, config, key)
 
     # ---- joint LBFGS over all parameters (lmfit.c:1019-1037) ----
     if config.max_lbfgs > 0:
@@ -728,42 +859,80 @@ def sagefit(
     else:
         lbfgs_trace = None
 
-    full1 = predict_full_model(p, cdata, data)
-    res_1 = _res_norm(data.vis - full1, data.mask, nreal)
-    telemetry = (
-        {"em": tuple(em_traces), "lbfgs": lbfgs_trace} if collect else None
-    )
-    quality = None
-    if collect_q:
-        # whole-solution bundle: chi^2 of the FULL residual (all cluster
-        # models subtracted) attributed per station/baseline, plus gain
-        # health over every (cluster, chunk) lane.  No hybrid-chunk
-        # structure exists for the joint residual, so chi2_chunk is the
-        # single total.
-        from sagecal_tpu.core.types import reals_of_flat
-        from sagecal_tpu.ops.quality import (
-            SolveQuality, chi2_scatter, gain_health, row_chi2,
-        )
+    return _finalize(data, cdata, p, res_0, mean_nu, config, lbfgs_trace,
+                     em_traces, em_quality)
 
-        e = reals_of_flat((data.vis - full1) * data.mask[..., None, :])
-        row = row_chi2(e)
-        chi2_st, chi2_bl, chi2_ch = chi2_scatter(
-            row, data.ant_p, data.ant_q, jnp.zeros_like(data.ant_p),
-            n8 // 8, 1,
+
+@true_f32
+def sagefit_batched_fused(
+    data: VisData,
+    cdata: ClusterData,
+    p0: jax.Array,
+    config: SageConfig = SageConfig(),
+    keys: Optional[jax.Array] = None,
+    valid: Optional[jax.Array] = None,
+) -> SageResult:
+    """B independent tile solves whose joint-LBFGS phase runs as ONE
+    batched fused Pallas kernel loop instead of B vmapped solo solves.
+
+    The EM phase (per-cluster LM/robust solves) is the existing
+    machinery vmapped per lane (:func:`_em_phase`); the joint LBFGS —
+    the hot loop that dominates serve latency — then advances all lanes
+    in lock-step through :func:`sagecal_tpu.solvers.lbfgs.
+    lbfgs_fit_batched`, so every cost/gradient evaluation is one
+    ``fused_cost_packed_batch`` grid with the lane axis folded into the
+    MXU contraction (ops/rime_kernel.py section comment).
+
+    Layout contract (solvers/batched.py): every ``data``/``cdata`` leaf
+    carries a leading batch axis B; all lanes share the SAME baseline
+    geometry (``ant_p``/``ant_q`` — the serve bucket guarantees this,
+    and :func:`sagecal_tpu.solvers.batched.choose_batched_path` checks
+    it host-side before routing here); ``p0`` is (B, M, 1, 8N) —
+    nchunk_max must be 1.  ``keys``: (B, 2) per-lane PRNG keys.
+    ``valid``: optional (B,) lane mask — replication-padded lanes still
+    run the EM phase on their (finite, replicated) data, but their mask
+    plane is zeroed in the batched cost pack so they contribute exactly
+    zero cost and zero cotangent to the LBFGS phase (the ragged-lane
+    guard; their lanes go inert after the first iteration and the
+    results are discarded host-side as before)."""
+    B, M, nchunk_max, n8 = p0.shape
+    if nchunk_max != 1:
+        raise ValueError(
+            "sagefit_batched_fused requires nchunk_max == 1 (the batched "
+            "kernel has no hybrid-chunk selection); use the vmapped path"
         )
-        nonfinite, amp, amp_sp, ph_sp, dep = gain_health(p)
-        final_q = SolveQuality(
-            chi2_station=chi2_st, chi2_baseline=chi2_bl,
-            chi2_chunk=chi2_ch, nonfinite_count=nonfinite,
-            station_amp=amp, station_amp_spread=amp_sp,
-            station_phase_spread=ph_sp, identity_departure=dep,
-            nu=mean_nu if robust else None,
+    if config.param_bound > 0.0 or config.collect_telemetry:
+        raise ValueError(
+            "batched fused path supports neither param_bound nor "
+            "telemetry traces; use the vmapped path"
         )
-        quality = {"em": em_quality, "final": final_q}
-    return SageResult(
-        p=p, res_0=res_0, res_1=res_1, mean_nu=mean_nu,
-        diverged=res_1 > res_0, telemetry=telemetry, quality=quality,
-    )
+    if keys is None:
+        keys = jax.random.split(jax.random.PRNGKey(0), B)
+    robust = config.solver_mode in _ROBUST_MODES
+
+    # quality side outputs (collect_quality) vmap straight through —
+    # only telemetry traces are excluded (guarded above)
+    p_b, mean_nu_b, res_0_b, _, em_q = jax.vmap(
+        lambda d, c, p, k: _em_phase(d, c, p, config, k)
+    )(data, cdata, p0, keys)
+
+    if config.max_lbfgs > 0:
+        from sagecal_tpu.solvers.lbfgs import lbfgs_fit_batched
+
+        cost_fn = _make_fused_joint_cost_batch(
+            data, cdata, B, M, n8, robust, mean_nu_b, config.coh_dtype,
+            valid,
+        )
+        fit = lbfgs_fit_batched(
+            cost_fn, p_b.reshape(B, -1), itmax=config.max_lbfgs,
+            M=config.lbfgs_m,
+        )
+        p_b = fit.p.reshape(B, M, nchunk_max, n8)
+
+    return jax.vmap(
+        lambda d, c, p, r0, mn, eq: _finalize(d, c, p, r0, mn, config,
+                                              None, [], eq)
+    )(data, cdata, p_b, res_0_b, mean_nu_b, em_q)
 
 
 # ------------------------------------------------ packed device boundary

@@ -741,3 +741,303 @@ def test_sky_gradient_fails_loudly():
         jax.grad(lambda c: fused_cost_packed_chunked(
             tab_re, tab_im, c, *args, vis_ri, mask_p, 5.0, TILE,
             rowsp))(coh_j)
+
+
+# ------------------------------------------------ batched fused objective
+
+
+def _batched_cost_problem(B=3, seed=30, M=3, N=6, F=2, rows=200):
+    """B same-shape lanes SHARING baseline geometry (the batched
+    kernel's layout contract) with per-lane Jones/coherencies/vis/mask;
+    returns complex host arrays, ready for pack_*_batch."""
+    rng = np.random.default_rng(seed)
+    ant_p = rng.integers(0, N - 1, rows)
+    ant_q = ant_p + rng.integers(1, N - ant_p)
+    jones_b = rng.standard_normal((B, M, N, 2, 2)) + 1j * (
+        rng.standard_normal((B, M, N, 2, 2)))
+    coh_b = rng.standard_normal((B, M, F, 4, rows)) + 1j * (
+        rng.standard_normal((B, M, F, 4, rows)))
+    vis_b = rng.standard_normal((B, F, 4, rows)) + 1j * (
+        rng.standard_normal((B, F, 4, rows)))
+    mask_b = (rng.random((B, F, rows)) > 0.15).astype(np.float32)
+    return jones_b, coh_b, vis_b, mask_b, ant_p, ant_q
+
+
+def _pack_batch(jones_b, coh_b, vis_b, mask_b, ant_p, ant_q, valid=None):
+    from sagecal_tpu.ops.rime_kernel import (
+        pack_cost_inputs_batch, pack_gain_tables_batch,
+    )
+
+    M = coh_b.shape[1]
+    mp = pad_to(M, MC)
+    vis_ri, mask_p, coh_ri, antp, antq = pack_cost_inputs_batch(
+        jnp.asarray(vis_b, jnp.complex64), jnp.asarray(mask_b),
+        jnp.asarray(coh_b, jnp.complex64), jnp.asarray(ant_p),
+        jnp.asarray(ant_q), TILE,
+        valid=None if valid is None else jnp.asarray(valid))
+    tre, tim = pack_gain_tables_batch(jnp.asarray(jones_b, jnp.complex64),
+                                      mp)
+    return tre, tim, coh_ri, antp, antq, vis_ri, mask_p, mp
+
+
+@pytest.mark.parametrize("nu", [None, 5.0], ids=["gaussian", "robust"])
+def test_batched_fused_cost_and_grad_match_vmapped_xla(nu):
+    """Acceptance bar (batched): per-lane cost AND batched-table
+    gradient of the one-grid batched objective within 1e-5 relative of
+    the per-lane XLA cost evaluated from identical packed inputs."""
+    from sagecal_tpu.ops.rime_kernel import fused_cost_packed_batch
+
+    B, M, N = 3, 3, 6
+    jones_b, coh_b, vis_b, mask_b, ant_p, ant_q = _batched_cost_problem(
+        B=B, seed=31, M=M, N=N)
+    tre, tim, coh_ri, antp, antq, vis_ri, mask_p, mp = _pack_batch(
+        jones_b, coh_b, vis_b, mask_b, ant_p, ant_q)
+    w = jnp.asarray(np.random.default_rng(32).uniform(0.5, 1.5, B),
+                    jnp.float32)
+
+    def ck(a, b):
+        return fused_cost_packed_batch(a, b, coh_ri, antp, antq, vis_ri,
+                                       mask_p, nu, TILE)
+
+    def lane_x(a, b, lane):
+        s = slice(lane * mp, (lane + 1) * mp)
+        return _xla_cost(a[:, s, :], b[:, s, :], coh_ri[s], antp, antq,
+                         vis_ri[lane], mask_p[lane], M, N, nu)
+
+    # per-lane values
+    vk = np.asarray(ck(tre, tim))
+    assert vk.shape == (B,)
+    for lane in range(B):
+        vx = float(lane_x(tre, tim, lane))
+        assert abs(float(vk[lane]) - vx) / abs(vx) <= 1e-5
+
+    # batched-table gradient of a per-lane-weighted total (the serve
+    # backward applies per-lane upstream cotangents the same way)
+    gk = jax.grad(lambda a, b: jnp.sum(w * ck(a, b)),
+                  argnums=(0, 1))(tre, tim)
+    gx = jax.grad(
+        lambda a, b: sum(w[lane] * lane_x(a, b, lane)
+                         for lane in range(B)),
+        argnums=(0, 1))(tre, tim)
+    for a, b in zip(gk, gx):
+        a, b = np.asarray(a), np.asarray(b)
+        assert np.abs(a - b).max() / np.abs(b).max() <= 1e-5
+        # padded cluster rows / station columns receive zero gradient
+        for lane in range(B):
+            np.testing.assert_array_equal(
+                a[:, lane * mp + M:(lane + 1) * mp, :], 0.0)
+        np.testing.assert_array_equal(a[:, :, N:], 0.0)
+
+
+def test_batched_fused_padded_lanes_zero_cost_and_cotangent():
+    """The replication-padded ragged-lane guard: a lane zeroed via
+    ``valid`` costs EXACTLY 0 (Gaussian and robust) and contributes an
+    exactly-zero gain cotangent, while the real lanes are bit-identical
+    to the same pack without the guard."""
+    from sagecal_tpu.ops.rime_kernel import fused_cost_packed_batch
+
+    B, M = 3, 3
+    jones_b, coh_b, vis_b, mask_b, ant_p, ant_q = _batched_cost_problem(
+        B=B, seed=33, M=M)
+    # lane 1 is the replicated pad
+    valid = np.array([True, False, True])
+    packed_v = _pack_batch(jones_b, coh_b, vis_b, mask_b, ant_p, ant_q,
+                           valid=valid)
+    packed_r = _pack_batch(jones_b, coh_b, vis_b, mask_b, ant_p, ant_q)
+    tre, tim, coh_ri, antp, antq, vis_ri_v, mask_v, mp = packed_v
+    vis_ri_r, mask_r = packed_r[5], packed_r[6]
+
+    for nu in (None, 5.0):
+        cv = np.asarray(fused_cost_packed_batch(
+            tre, tim, coh_ri, antp, antq, vis_ri_v, mask_v, nu, TILE))
+        cr = np.asarray(fused_cost_packed_batch(
+            tre, tim, coh_ri, antp, antq, vis_ri_r, mask_r, nu, TILE))
+        assert float(cv[1]) == 0.0  # exactly zero, not merely small
+        np.testing.assert_array_equal(cv[[0, 2]], cr[[0, 2]])
+
+        gv = jax.grad(
+            lambda a, b: jnp.sum(fused_cost_packed_batch(
+                a, b, coh_ri, antp, antq, vis_ri_v, mask_v, nu, TILE)),
+            argnums=(0, 1))(tre, tim)
+        for g in gv:
+            np.testing.assert_array_equal(
+                np.asarray(g)[:, mp:2 * mp, :], 0.0)
+
+
+def _batched_solve_problem(B=3, N=5, M=2, F=2, tilesz=2, seed=40):
+    """B small same-geometry tiles as batched VisData/ClusterData plus
+    (B, M, 1, 8N) f32 initial gains — the sagefit_packed_batch layout."""
+    from sagecal_tpu.core.types import VisData
+    from sagecal_tpu.solvers.sage import ClusterData
+
+    nbase = N * (N - 1) // 2
+    rows = nbase * tilesz
+    pp, qq = np.triu_indices(N, 1)
+    ant_p = np.tile(pp, tilesz).astype(np.int32)
+    ant_q = np.tile(qq, tilesz).astype(np.int32)
+    time_idx = np.repeat(np.arange(tilesz), nbase).astype(np.int32)
+
+    def lane(s):
+        r = np.random.default_rng(s)
+        coh = (r.normal(size=(M, F, 4, rows))
+               + 1j * r.normal(size=(M, F, 4, rows))).astype(np.complex64)
+        vis = (r.normal(size=(F, 4, rows))
+               + 1j * r.normal(size=(F, 4, rows))).astype(np.complex64)
+        mask = np.ones((F, rows), np.float32)
+        mask[:, ::7] = 0.0
+        p0 = (np.tile(np.array([1, 0, 0, 0, 0, 0, 1, 0], np.float32), N)
+              [None, None, :].repeat(M, 0)
+              + 0.05 * r.normal(size=(M, 1, 8 * N)).astype(np.float32))
+        return coh, vis, mask, p0
+
+    lanes = [lane(seed + i) for i in range(B)]
+
+    def mk(vis, mask, coh):
+        data = VisData(
+            u=jnp.zeros(rows, jnp.float32), v=jnp.zeros(rows, jnp.float32),
+            w=jnp.zeros(rows, jnp.float32), ant_p=jnp.asarray(ant_p),
+            ant_q=jnp.asarray(ant_q), vis=jnp.asarray(vis),
+            mask=jnp.asarray(mask),
+            freqs=jnp.full((F,), 150e6, jnp.float32),
+            time_idx=jnp.asarray(time_idx), tilesz=tilesz, nbase=nbase,
+            nstations=N)
+        cdata = ClusterData(coh=jnp.asarray(coh),
+                            chunk_map=jnp.zeros((M, rows), jnp.int32),
+                            nchunk=jnp.ones((M,), jnp.int32))
+        return data, cdata
+
+    pairs = [mk(v, m, c) for c, v, m, _ in lanes]
+    data_b = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                    *[p[0] for p in pairs])
+    cdata_b = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                     *[p[1] for p in pairs])
+    p0_b = jnp.asarray(np.stack([l[3] for l in lanes]))
+    return data_b, cdata_b, p0_b
+
+
+@pytest.mark.parametrize("mode", [1, 3], ids=["gaussian", "robust"])
+def test_batched_fused_solve_matches_vmapped_xla(mode):
+    """Solve-level parity: the batched-fused route of
+    sagefit_packed_batch agrees with the vmapped XLA route on gains and
+    residuals (the routing the serve dispatch bakes into its cache
+    entries)."""
+    from sagecal_tpu.solvers.batched import (
+        choose_batched_path, sagefit_packed_batch,
+    )
+    from sagecal_tpu.solvers.sage import SageConfig
+
+    data_b, cdata_b, p0_b = _batched_solve_problem(seed=41)
+    B = p0_b.shape[0]
+    cfg = SageConfig(max_emiter=1, max_iter=2, max_lbfgs=6,
+                     solver_mode=mode, use_fused_predict=True)
+    path, reason = choose_batched_path(data_b, cdata_b, p0_b, cfg)
+    assert path == "fused_batch", reason
+    keys = jax.random.split(jax.random.PRNGKey(5), B)
+    vr, vi = jnp.real(data_b.vis), jnp.imag(data_b.vis)
+    cr, ci = jnp.real(cdata_b.coh), jnp.imag(cdata_b.coh)
+    d0 = data_b.replace(vis=None)
+    c0 = cdata_b._replace(coh=None)
+    out_f = sagefit_packed_batch(d0, c0, vr, vi, cr, ci, p0_b, cfg, keys,
+                                 batched_fused=True)
+    out_x = sagefit_packed_batch(d0, c0, vr, vi, cr, ci, p0_b,
+                                 cfg.replace(use_fused_predict=False),
+                                 keys)
+    assert float(jnp.max(jnp.abs(out_f.p - out_x.p))) <= 1e-4
+    assert float(jnp.max(jnp.abs(out_f.res_1 - out_x.res_1))) <= 1e-5
+
+
+def test_batched_solve_donated_bit_identical_and_consumes_input():
+    """sagefit_packed_batch_jit donates the batch gains carry: the
+    batched-fused solve must be bit-identical to an undonated call of
+    the same route, and the donated buffer must be consumed."""
+    import functools
+
+    from sagecal_tpu.solvers.batched import (
+        sagefit_packed_batch, sagefit_packed_batch_jit,
+    )
+    from sagecal_tpu.solvers.sage import SageConfig
+
+    data_b, cdata_b, p0_b = _batched_solve_problem(seed=42)
+    B = p0_b.shape[0]
+    cfg = SageConfig(max_emiter=1, max_iter=1, max_lbfgs=4,
+                     solver_mode=1, use_fused_predict=True)
+    keys = jax.random.split(jax.random.PRNGKey(6), B)
+    vr, vi = jnp.real(data_b.vis), jnp.imag(data_b.vis)
+    cr, ci = jnp.real(cdata_b.coh), jnp.imag(cdata_b.coh)
+    d0 = data_b.replace(vis=None)
+    c0 = cdata_b._replace(coh=None)
+
+    plain = jax.jit(functools.partial(sagefit_packed_batch,
+                                      batched_fused=True))
+    p_ref = jnp.array(p0_b)
+    r_ref = plain(d0, c0, vr, vi, cr, ci, p_ref, cfg, keys)
+
+    p_don = jnp.array(p0_b)
+    r_don = sagefit_packed_batch_jit(d0, c0, vr, vi, cr, ci, p_don, cfg,
+                                     keys, batched_fused=True)
+
+    np.testing.assert_array_equal(np.asarray(r_don.p), np.asarray(r_ref.p))
+    np.testing.assert_array_equal(np.asarray(r_don.res_1),
+                                  np.asarray(r_ref.res_1))
+    assert p_don.is_deleted()
+    assert not p_ref.is_deleted()
+
+
+def test_batched_bucket_zero_recompile_across_batch_widths():
+    """Same-bucket batches with different REAL lane counts (a full
+    bucket, then a ragged one replication-padded to the same width)
+    reuse ONE batched-fused executable: cache counters show a single
+    miss and the instrumented entry a single compile."""
+    from sagecal_tpu.obs.perf import perf_stats, reset_perf_stats
+    from sagecal_tpu.obs.registry import telemetry
+    from sagecal_tpu.serve.bucket import bucket_of, pad_indices
+    from sagecal_tpu.serve.cache import ExecutableCache
+    from sagecal_tpu.solvers.batched import (
+        choose_batched_path, derive_lane_keys,
+    )
+    from sagecal_tpu.solvers.sage import SageConfig
+
+    width = 2
+    data_b, cdata_b, p0_b = _batched_solve_problem(B=width, seed=43)
+    cfg = SageConfig(max_emiter=1, max_iter=1, max_lbfgs=4,
+                     solver_mode=1, use_fused_predict=True)
+    path, reason = choose_batched_path(data_b, cdata_b, p0_b, cfg)
+    assert path == "fused_batch", reason
+
+    data0 = jax.tree_util.tree_map(lambda x: x[0], data_b)
+    cdata0 = jax.tree_util.tree_map(lambda x: x[0], cdata_b)
+    bucket = bucket_of(data0, cdata0, np.asarray(p0_b[0]))
+    vr, vi = jnp.real(data_b.vis), jnp.imag(data_b.vis)
+    cr, ci = jnp.real(cdata_b.coh), jnp.imag(cdata_b.coh)
+    d0 = data_b.replace(vis=None)
+    c0 = cdata_b._replace(coh=None)
+
+    def dispatch(fn, idx, valid):
+        take = lambda t: jax.tree_util.tree_map(
+            lambda x: jnp.asarray(np.asarray(x)[np.asarray(idx)]), t)
+        keys = derive_lane_keys(0, np.asarray(idx, np.uint32))
+        out = fn(take(d0), take(c0), take(vr), take(vi), take(cr),
+                 take(ci), jnp.asarray(np.asarray(p0_b)[np.asarray(idx)]),
+                 cfg, keys, jnp.asarray(valid))
+        np.asarray(out.p)
+
+    reset_perf_stats()
+    cache = ExecutableCache()
+    with telemetry():
+        # full bucket: 2 real lanes
+        fn, hit = cache.get_with_status(bucket, "fp", batched_fused=True)
+        assert not hit
+        dispatch(fn, [0, 1], [True, True])
+        # ragged bucket: 1 real lane replication-padded to the width
+        idx, valid = pad_indices(1, width)
+        fn2, hit2 = cache.get_with_status(bucket, "fp",
+                                          batched_fused=True)
+        assert hit2 and fn2 is fn
+        dispatch(fn2, idx, valid)
+    assert cache.stats() == {"hits": 1, "misses": 1, "entries": 1}
+    batch_entries = {k: v for k, v in perf_stats().items()
+                     if k.startswith("serve_batch[")}
+    assert len(batch_entries) == 1
+    (name, st), = batch_entries.items()
+    assert st["compiles"] == 1, \
+        f"{name} recompiled across same-bucket batch widths: {st}"

@@ -1,0 +1,246 @@
+"""AOT bytes-accessed comparison: batched fused objective vs vmapped XLA.
+
+The batched analog of tools/bench_fused_bytes.py, gating the tentpole
+claim of the batched serve hot path with compiler evidence and NO
+execution: one ``fused_cost_packed_batch`` Pallas grid evaluating a
+whole bucket of lanes (predict, masked residual, Student's-t weighting,
+per-lane reduction and the in-register residual-cotangent backward in
+ONE pass over the lane-major coherency stack) must access at least
+``--min-reduction`` fewer bytes per batched ``value_and_grad`` than
+
+- ``vmapped_xla_predict_plus_cost``: ``jax.vmap`` of the pure-XLA cost
+  (``predict_full_model`` einsum predict over complex coherencies + XLA
+  residual/robust reduction) — the path the serve layer runs when the
+  batched kernel's capability checks fail.  The XLA path materializes
+  the (M, rows)-scale broadcast gain-component arrays forward AND their
+  cotangents backward PER LANE; the batched kernel forms both
+  in-register.  Coherencies are passed to the XLA side already complex,
+  so its per-step real->complex conversion is NOT counted against it
+  (conservative).
+
+Shape: the gated serve-bench geometry widened to a full cluster block —
+B=8 lanes x N=62 stations x M=8 directions x 1 timeslot x 1 channel.
+M=8 keeps the kernel's cluster padding honest: the batched tables pad
+M up to ``pad_to(M, 8)``, so an M=2 comparison would charge the kernel
+for streaming 4x zero-padded coherency rows the XLA path never touches
+— at M=8 both sides stream exactly the real data.  B*Mp = 64 sits
+inside the backward kernel's VMEM accumulator bound
+(solvers/batched._BATCH_ROWS_MAX = 104), i.e. this is a shape
+``choose_batched_path`` actually routes to ``fused_batch``.
+
+Everything is lowered from ``jax.ShapeDtypeStruct`` abstract arguments
+on the CPU backend and compared via
+``compiled.cost_analysis()["bytes accessed"]`` — the same figure
+bench.py banks and `diag gate` regresses (lower-better).  On CPU the
+Pallas kernel lowers in interpret mode, whose grid-loop emulation
+inflates the kernel's figure; the measured reduction is therefore a
+LOWER bound on the hardware saving.
+
+Writes two bench-format JSON records so the claim is gate-checkable::
+
+    python tools/bench_batched_bytes.py --out-new BENCH_batched_bytes.json \
+        --out-baseline BENCH_batched_bytes_baseline.json
+    python -m sagecal_tpu.obs.diag gate BENCH_batched_bytes.json \
+        --baseline BENCH_batched_bytes_baseline.json \
+        --metric xla_cost_analysis_bytes_accessed=-0.50
+
+(a negative tolerance on a lower-better metric asserts an improvement:
+the batched-fused record must stay below 0.50x the vmapped-XLA record).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# bare-checkout support: make the adjacent package importable
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def _bytes_accessed(compiled) -> float:
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    return float(cost.get("bytes accessed", 0.0))
+
+
+def build_batched_fused(batch, nstations, nclusters, nchan, tilesz, nu):
+    """value_and_grad of the summed per-lane batched fused objective
+    w.r.t. the batched gain tables (lanes are independent, so the grad
+    of the sum IS the stack of per-lane grads — the serve backward
+    applies per-lane upstream cotangents as a row-block scale on the
+    same kernel)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sagecal_tpu.ops.rime_kernel import (
+        FULL_CLUSTER_TILE,
+        MAX_GRID_ROWS,
+        NPAD,
+        chunked_rowsp,
+        fused_cost_packed_batch,
+        pad_to,
+    )
+
+    rows = nstations * (nstations - 1) // 2 * tilesz
+    mp = pad_to(nclusters, 8)
+    rowsp = chunked_rowsp(rows, FULL_CLUSTER_TILE, MAX_GRID_ROWS)
+    f32 = jnp.float32
+    sds = jax.ShapeDtypeStruct
+    tab = sds((4, batch * mp, NPAD), f32)
+    coh = sds((batch * mp, nchan, 8, rowsp), f32)
+    ant = sds((1, rowsp), jnp.int32)
+    vis = sds((batch, nchan, 8, rowsp), f32)
+    mask = sds((batch, nchan, rowsp), f32)
+
+    def cost(tre, tim, coh_p, antp, antq, vis_p, mask_p):
+        per_lane = fused_cost_packed_batch(
+            tre, tim, coh_p, antp, antq, vis_p, mask_p, nu,
+            FULL_CLUSTER_TILE, MAX_GRID_ROWS)
+        return jnp.sum(per_lane)
+
+    def f(tre, tim, coh_p, antp, antq, vis_p, mask_p):
+        return jax.value_and_grad(cost, argnums=(0, 1))(
+            tre, tim, coh_p, antp, antq, vis_p, mask_p)
+
+    shape = {
+        "batch": batch, "nstations": nstations, "nclusters": nclusters,
+        "nchan": nchan, "tilesz": tilesz, "rows": rows, "rowsp": rowsp,
+        "mp": mp, "batch_rows": batch * mp,
+    }
+    return jax.jit(f), (tab, tab, coh, ant, ant, vis, mask), shape
+
+
+def build_vmapped_xla(batch, nstations, nclusters, nchan, tilesz, nu):
+    """value_and_grad of the summed vmapped pure-XLA cost w.r.t. the
+    (B, M, 1, 8N) gain parameters — the serve fallback program."""
+    import jax
+    import jax.numpy as jnp
+
+    from sagecal_tpu.core.types import VisData
+    from sagecal_tpu.solvers.sage import ClusterData, predict_full_model
+
+    nbase = nstations * (nstations - 1) // 2
+    rows = nbase * tilesz
+    f32, c64, i32 = jnp.float32, jnp.complex64, jnp.int32
+    sds = jax.ShapeDtypeStruct
+    p = sds((batch, nclusters, 1, 8 * nstations), f32)
+    coh = sds((batch, nclusters, nchan, 4, rows), c64)
+    vis = sds((batch, nchan, 4, rows), c64)
+    mask = sds((batch, nchan, rows), f32)
+    ant = sds((rows,), i32)
+    cmap = sds((batch, nclusters, rows), i32)
+
+    def lane_cost(pa, coh_c, cmap_d, vis_c, mask_d, antp, antq):
+        zr = jnp.zeros((rows,), f32)
+        data = VisData(u=zr, v=zr, w=zr, ant_p=antp, ant_q=antq,
+                       vis=vis_c, mask=mask_d,
+                       freqs=jnp.zeros((nchan,), f32),
+                       time_idx=jnp.zeros((rows,), i32),
+                       tilesz=tilesz, nbase=nbase, nstations=nstations)
+        cdata = ClusterData(coh=coh_c, chunk_map=cmap_d,
+                            nchunk=jnp.ones((nclusters,), i32))
+        model = predict_full_model(pa, cdata, data)
+        diff = (vis_c - model) * mask_d[:, None, :]
+        e2 = jnp.real(diff) ** 2 + jnp.imag(diff) ** 2
+        return jnp.sum(jnp.log1p(e2 / nu))
+
+    def cost(p_b, coh_b, cmap_b, vis_b, mask_b, antp, antq):
+        per_lane = jax.vmap(
+            lane_cost, in_axes=(0, 0, 0, 0, 0, None, None)
+        )(p_b, coh_b, cmap_b, vis_b, mask_b, antp, antq)
+        return jnp.sum(per_lane)
+
+    def f(p_b, coh_b, cmap_b, vis_b, mask_b, antp, antq):
+        return jax.value_and_grad(cost)(
+            p_b, coh_b, cmap_b, vis_b, mask_b, antp, antq)
+
+    return jax.jit(f), (p, coh, cmap, vis, mask, ant, ant)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--batch", type=int, default=8,
+                    help="lanes per bucketed batch (serve default)")
+    ap.add_argument("--nstations", type=int, default=62,
+                    help="stations (62 = the gated serve-bench count)")
+    ap.add_argument("--nclusters", type=int, default=8,
+                    help="directions (8 = one full cluster block; see "
+                         "module docstring for why not 2)")
+    ap.add_argument("--nchan", type=int, default=1)
+    ap.add_argument("--tilesz", type=int, default=1,
+                    help="timeslots per tile (1 = a serving request)")
+    ap.add_argument("--nu", type=float, default=5.0)
+    ap.add_argument("--min-reduction", type=float, default=0.50,
+                    help="required fractional reduction of the batched "
+                         "fused objective vs the vmapped XLA program "
+                         "(exit 1 below)")
+    ap.add_argument("--out-new", default=None,
+                    help="bench-format JSON for the batched-fused record")
+    ap.add_argument("--out-baseline", default=None,
+                    help="bench-format JSON for the vmapped-XLA record")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # AOT analysis only
+
+    from sagecal_tpu.ops.rime_kernel import pad_to
+    from sagecal_tpu.solvers.batched import _BATCH_ROWS_MAX
+
+    batch_rows = args.batch * pad_to(args.nclusters, 8)
+    if batch_rows > _BATCH_ROWS_MAX:
+        print(f"B*Mp={batch_rows} exceeds the backward kernel's VMEM "
+              f"bound ({_BATCH_ROWS_MAX}); choose_batched_path would "
+              f"never route this shape to fused_batch", file=sys.stderr)
+        return 2
+
+    fused, fsig, shape = build_batched_fused(
+        args.batch, args.nstations, args.nclusters, args.nchan,
+        args.tilesz, args.nu)
+    xla, xsig = build_vmapped_xla(
+        args.batch, args.nstations, args.nclusters, args.nchan,
+        args.tilesz, args.nu)
+
+    recs = {}
+    for name, fn, sig in (
+            ("batched_fused_objective", fused, fsig),
+            ("vmapped_xla_predict_plus_cost", xla, xsig)):
+        compiled = fn.lower(*sig).compile()
+        recs[name] = _bytes_accessed(compiled)
+        print(f"{name}: bytes_accessed = {recs[name]:.6g}")
+
+    b_new = recs["batched_fused_objective"]
+    red = 1.0 - b_new / recs["vmapped_xla_predict_plus_cost"]
+    print(f"reduction vs vmapped_xla_predict_plus_cost: {red:.1%} "
+          f"(required >= {args.min_reduction:.0%})")
+
+    for path, name in ((args.out_new, "batched_fused_objective"),
+                       (args.out_baseline,
+                        "vmapped_xla_predict_plus_cost")):
+        if not path:
+            continue
+        rec = {
+            "metric": "batched_fused_objective_bytes_accessed",
+            "variant": name,
+            "unit": "bytes accessed per batched value_and_grad cost "
+                    "evaluation (AOT cost_analysis, no execution)",
+            "platform": "cpu-aot",
+            "xla_cost_analysis_bytes_accessed": recs[name],
+            "reduction_vs_vmapped_xla": round(red, 4),
+            **shape,
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {path}")
+
+    return 0 if red >= args.min_reduction else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

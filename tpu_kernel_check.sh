@@ -35,12 +35,25 @@ if ! JAX_PLATFORMS=cpu timeout 120 python -m sagecal_tpu.obs.diag lint \
     sagecal_tpu/; then
   echo "LINT GATE FAILED (new jaxlint findings) - stop"; exit 1
 fi
+# the batched serve dispatch donates whole batch carries into one grid;
+# a use-after-donation there corrupts EVERY lane in the bucket, so spot
+# re-check JL011 on exactly those files even when the baseline is dirty
+echo "=== jaxlint JL011 spot-check (batched donation surface)"
+if ! JAX_PLATFORMS=cpu timeout 120 python tools/jaxlint.py --rules JL011 \
+    sagecal_tpu/solvers/batched.py sagecal_tpu/serve/service.py \
+    sagecal_tpu/serve/cache.py sagecal_tpu/fleet/worker.py bench.py; then
+  echo "JL011 SPOT-CHECK FAILED (use-after-donation on batched path) - stop"
+  exit 1
+fi
 # fused-OBJECTIVE parity smoke next, still CPU-only: the interpret-mode
 # kernel must match the XLA replica (cost + grad <=1e-5 rel, masked and
-# padded edges) before any TPU time is spent on it
+# padded edges) before any TPU time is spent on it; batched_fused covers
+# the lane-packed grid (per-lane parity, ragged-lane zero guard,
+# donated-batch bit-identity, zero-recompile bucket reuse)
 echo "=== fused-objective CPU parity smoke (interpret vs XLA)"
-JAX_PLATFORMS=cpu timeout 480 python -m pytest tests/test_rime_kernel.py -q \
-  -k "fused_cost or fused_objective or donated" -p no:cacheprovider | tail -3
+JAX_PLATFORMS=cpu timeout 900 python -m pytest tests/test_rime_kernel.py -q \
+  -k "fused_cost or fused_objective or donated or batched_fused or batched_solve or batched_bucket" \
+  -p no:cacheprovider | tail -3
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then echo "fused parity smoke FAILED rc=$rc - stop"; exit 1; fi
 # AOT HBM-traffic gate (no execution, CPU): the fused objective must
@@ -51,6 +64,17 @@ JAX_PLATFORMS=cpu timeout 480 python tools/bench_fused_bytes.py \
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then
   echo "AOT BYTES GATE FAILED (fused objective lost its traffic win)"; exit 1
+fi
+# batched analog: ONE lane-major grid for a whole serve bucket must cut
+# >=50% of the vmapped-XLA fallback's bytes (tools/bench_batched_bytes.py
+# docstring explains the M=8 shape choice and why this is a lower bound)
+echo "=== batched-fused AOT bytes gate"
+JAX_PLATFORMS=cpu timeout 600 python tools/bench_batched_bytes.py \
+  --min-reduction 0.50 | tail -3
+rc=${PIPESTATUS[0]}
+if [ "$rc" != 0 ]; then
+  echo "BATCHED AOT BYTES GATE FAILED (batched grid lost its traffic win)"
+  exit 1
 fi
 step bisect-c 200 python kbisect.py c
 step bisect-b 200 python kbisect.py b
