@@ -921,6 +921,144 @@ def run_fleet_bench(n_requests=6, workers=2, timeout=1200.0):
     }
 
 
+def run_widefield_bench(nsources=10000, nblobs=40, nstations=40,
+                        order=8, theta=1.5, repeats=5, seed=3):
+    """Wide-field hierarchical-predict row: compiled memory traffic and
+    wall clock of ``predict_coherencies_hier`` vs the exact predict at
+    the 10k-source shape, plus the sampled a-posteriori error.
+
+    The gated headline is ``hier_predict_speedup`` = exact/hier
+    compiled BYTES ACCESSED from AOT ``cost_analysis()`` — deterministic
+    and host-load-independent, unlike wall clock (recorded alongside as
+    ``wall_speedup``).  The exact side is lowered with
+    ``source_chunk = nsources`` (a single chunk): XLA's cost analysis
+    counts a scan body ONCE regardless of trip count, so a chunked
+    lowering under-reports the exact path's true traffic by the trip
+    count — the single-chunk program is the chunk-size-invariant total.
+    ``hier_predict_max_rel_err`` (lower-better, gated) is the sampled
+    error of the hier stack vs exact rows at the DEFAULT knob
+    (order=8, theta=1.5; a-priori bound 1.06e-4).
+
+    Geometry is the compact-array / low-frequency / wide-fov regime
+    (60 m stations, 30 MHz, ~1.1 rad field) — the regime the expansion
+    targets: admissibility needs ``2*pi*f*|b|*r_node <= theta``, which
+    a km-scale array at 150 MHz never satisfies.  f64 via the scoped
+    x64 context so the row is independent of the headline dtype.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    with enable_x64(), jax.default_device(_cpu_device()):
+        from sagecal_tpu.io.simulate import make_visdata
+        from sagecal_tpu.ops.rime import (
+            point_source_batch,
+            predict_coherencies,
+        )
+        from sagecal_tpu.sky.predict import (
+            _hier_core,
+            build_hier_plan,
+            predict_coherencies_hier,
+            sampled_error_estimate,
+        )
+
+        data = make_visdata(nstations=nstations, tilesz=2, nchan=1,
+                            freq0=30e6, seed=1, dtype=np.float64,
+                            extent_m=60.0)
+        rng = np.random.default_rng(seed)
+        per = np.full(nblobs, nsources // nblobs)
+        per[: nsources % nblobs] += 1
+        cx = rng.uniform(-0.55, 0.55, nblobs)
+        cy = rng.uniform(-0.55, 0.55, nblobs)
+        ll = np.concatenate([c + 0.004 * rng.standard_normal(n)
+                             for c, n in zip(cx, per)])
+        mm = np.concatenate([c + 0.004 * rng.standard_normal(n)
+                             for c, n in zip(cy, per)])
+        keep = ll * ll + mm * mm < 0.95
+        ll, mm = ll[keep], mm[keep]
+        flux = 0.1 * rng.pareto(2.0, ll.shape[0]) + 0.05
+        src = point_source_batch(ll, mm, flux, f0=30e6, dtype=jnp.float64)
+        S = int(ll.shape[0])
+
+        plan = build_hier_plan(data.u, data.v, data.w, data.freqs, src,
+                               theta=theta)
+        T, R = plan.routing.ntiles, plan.routing.tile_rows
+        rows = plan.routing.rows
+        pad = T * R - rows
+        u_t = jnp.pad(data.u[plan.row_perm], (0, pad)).reshape(T, R)
+        v_t = jnp.pad(data.v[plan.row_perm], (0, pad)).reshape(T, R)
+        w_t = jnp.pad(data.w[plan.row_perm], (0, pad)).reshape(T, R)
+
+        def aot_bytes(lowered):
+            cost = lowered.compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            return float(cost.get("bytes accessed", 0.0))
+
+        hier_bytes = aot_bytes(jax.jit(
+            _hier_core.__wrapped__,
+            static_argnums=(11, 12, 13, 14, 15, 16, 17),
+        ).lower(
+            u_t, v_t, w_t, data.freqs, src,
+            plan.node_of_source, plan.node_center,
+            plan.far_idx, plan.far_valid, plan.near_src, plan.near_valid,
+            order, plan.nnodes, 0.0, 32, plan.use_far, plan.use_near,
+            plan.npol))
+        exact_bytes = aot_bytes(jax.jit(
+            lambda u, v, w, f, s: predict_coherencies(
+                u, v, w, f, s, 0.0, S,
+                has_extended=False, has_shapelet=False),
+        ).lower(data.u, data.v, data.w, data.freqs, src))
+
+        def timed(fn):
+            fn().block_until_ready()  # warm the jit cache
+            best = min(
+                _timeit(lambda: fn().block_until_ready())
+                for _ in range(repeats))
+            return best
+
+        def _timeit(fn):
+            t0 = time.perf_counter()
+            fn()
+            return time.perf_counter() - t0
+
+        hier_wall = timed(lambda: predict_coherencies_hier(
+            data.u, data.v, data.w, data.freqs, src,
+            order=order, theta=theta, plan=plan))
+        # deployed chunking on the exact side (source_chunk=256): wall
+        # clock reflects what callers actually run, unlike the
+        # single-chunk lowering used for the traffic total
+        exact_wall = timed(lambda: predict_coherencies(
+            data.u, data.v, data.w, data.freqs, src, 0.0, 256,
+            has_extended=False, has_shapelet=False))
+
+        coh = predict_coherencies_hier(
+            data.u, data.v, data.w, data.freqs, src,
+            order=order, theta=theta, plan=plan)
+        est = sampled_error_estimate(
+            data.u, data.v, data.w, data.freqs, src, coh,
+            nsample=256, seed=0)
+    st = plan.stats()
+    return {
+        "nsources": S,
+        "rows": rows,
+        "order": order,
+        "theta": theta,
+        "tree_depth": st["depth"],
+        "far_pairs": st["far_pairs"],
+        "near_sources_total": st["near_sources_total"],
+        "npol": plan.npol,
+        "hier_aot_bytes": hier_bytes,
+        "exact_aot_bytes_single_chunk": exact_bytes,
+        "hier_predict_speedup": round(exact_bytes / hier_bytes, 3),
+        "hier_wall_s": round(hier_wall, 5),
+        "exact_wall_s": round(exact_wall, 5),
+        "wall_speedup": round(exact_wall / max(hier_wall, 1e-9), 3),
+        "hier_predict_max_rel_err": float(est["rel_err"]),
+        "error_nsample": int(est["nsample"]),
+    }
+
+
 def _latest_flight_dump():
     """Newest flight-recorder dump matching the configured dump path, so
     the recovery event links straight to the forensics artifact."""
@@ -1121,6 +1259,17 @@ def main():
             except Exception as exc:  # never sink the headline bench
                 sys.stderr.write(f"bench: fleet bench failed: {exc}\n")
 
+    # wide-field hierarchical-predict row: compiled-traffic ratio vs the
+    # exact predict at the 10k-source shape + sampled error at the
+    # default (order, theta) knob.  SAGECAL_BENCH_NO_WIDEFIELD=1 skips.
+    widefield_rec = None
+    if not os.environ.get("SAGECAL_BENCH_NO_WIDEFIELD"):
+        with tracer.span("bench", kind="run", variant="widefield"):
+            try:
+                widefield_rec = run_widefield_bench()
+            except Exception as exc:  # never sink the headline bench
+                sys.stderr.write(f"bench: widefield bench failed: {exc}\n")
+
     cpu_measured = None
     if os.environ.get("SAGECAL_BENCH_MEASURE_CPU"):
         cpu_measured = _measure_cpu_subprocess(tilesz)
@@ -1253,6 +1402,14 @@ def main():
         rec["fleet_solves_per_sec_2workers"] = (
             fleet_rec["fleet_solves_per_sec_2workers"])
         rec["fleet_bench"] = fleet_rec
+    if widefield_rec is not None:
+        # gate-able wide-field hierarchical-predict rows (obs/perf.py
+        # knows the directions): compiled-traffic ratio higher-better,
+        # sampled error lower-better
+        rec["hier_predict_speedup"] = widefield_rec["hier_predict_speedup"]
+        rec["hier_predict_max_rel_err"] = (
+            widefield_rec["hier_predict_max_rel_err"])
+        rec["widefield_bench"] = widefield_rec
     if bf16_variant is not None:
         # gate-able bf16-coherency row (obs/perf.py knows directions):
         # throughput higher-better, compiled bytes accessed lower-better

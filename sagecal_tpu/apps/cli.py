@@ -303,6 +303,13 @@ def main(argv=None):
         from sagecal_tpu.apps.stream import main as stream_main
 
         return stream_main(argv[1:])
+    if argv and argv[0] == "widefield":
+        # wide-field calibration via the tree-clustered hierarchical
+        # sky predict (sagecal_tpu/sky/); owns its own flag surface
+        # and exit codes (apps/widefield.py)
+        from sagecal_tpu.apps.widefield import main as widefield_main
+
+        return widefield_main(argv[1:])
     if argv and argv[0] == "refine":
         # differentiable sky-model refinement (sagecal_tpu/refine/):
         # outer LBFGS over sky parameters around the inner gain solve;

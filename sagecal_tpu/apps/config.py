@@ -352,3 +352,62 @@ class StreamConfig:
     nchan: int = 2
     noise_sigma: float = 0.0
     seed: int = 7
+
+
+@dataclasses.dataclass
+class WidefieldConfig:
+    """``sagecal-tpu widefield``: 10k+-source wide-field calibration
+    through the hierarchical sky predict (sagecal_tpu/sky/).  A
+    synthetic compact-array/all-sky observation is generated with
+    ``data.simsky.make_sky(wide_field=True)``, the full source list is
+    collapsed into ``nclusters`` tree-partitioned effective calibration
+    directions, and each tile's cluster coherencies come from
+    ``predict_coherencies_hier`` (a-posteriori-verified by the quality
+    watchdog) before the standard packed SAGE solve."""
+
+    out_dir: str = "widefield-out"
+    # synthetic wide-field sky (data/simsky.py wide_field branch)
+    nstations: int = 24
+    ntiles: int = 4             # solve tiles (total obs = ntiles*tilesz)
+    tilesz: int = 2             # time samples per solve tile
+    nchan: int = 1
+    nsources: int = 2000        # total point sources across the field
+    nblobs: int = 12            # spatial blobs the sky generator draws
+    fov: float = 1.1            # field diameter, direction cosines
+    cluster_scale: float = 0.004
+    freq0: float = 30e6         # low-frequency all-sky regime
+    extent_m: float = 80.0      # compact-array station layout radius
+    gain_amp: float = 0.1
+    noise_sigma: float = 0.0
+    seed: int = 11
+    # hierarchical predict knobs (sky/predict.py)
+    nclusters: int = 4          # tree-collapsed effective directions
+    order: int = 8              # multipole/Taylor truncation order p
+    theta: float = 1.5          # well-separation phase budget (rad)
+    leaf_size: int = 32
+    tile_rows: int = 128
+    source_chunk: int = 32
+    exact: bool = False         # route through the exact predict instead
+    # a-posteriori verification (sky.predict.sampled_error_estimate ->
+    # obs.quality.check_hier_predict): rows sampled per tile; the
+    # verdict degrades when the sampled error exceeds max_rel_err
+    # (<= 0 uses the a-priori bound of (order, theta))
+    hier_nsample: int = 32
+    hier_max_rel_err: float = 1e-3
+    # solver (RunConfig semantics)
+    max_emiter: int = 3
+    max_iter: int = 2
+    max_lbfgs: int = 10
+    lbfgs_m: int = 7
+    solver_mode: int = SM_OSLM_OSRLM_RLBFGS
+    nulow: float = 2.0
+    nuhigh: float = 30.0
+    randomize: bool = True
+    res_ratio: float = 5.0
+    abort_on_divergence: bool = False
+    # elastic (checkpoint at tile boundaries; bit-exact resume)
+    resume: bool = False
+    checkpoint_every: int = 0
+    checkpoint_dir: Optional[str] = None
+    use_f64: bool = True
+    verbose: bool = False

@@ -105,6 +105,13 @@ def make_sky(
     shapelet_n0: int = 0,
     seed: int = 7,
     dtype=np.float64,
+    wide_field: bool = False,
+    nsources: int = 10000,
+    fov: float = 1.1,
+    cluster_scale: float = 0.004,
+    flux_alpha: float = 2.0,
+    flux_min: float = 0.05,
+    extent_m: float = 3000.0,
 ) -> SimulatedSky:
     """Build a point(+shapelet) sky with known ground truth and observe
     it through random Jones gains.
@@ -120,11 +127,25 @@ def make_sky(
     - ``gain_amp=0`` observes through identity gains (the refinement
       acceptance setting: at the true sky + identity anchor the outer
       misfit is exactly the noise floor).
+
+    ``wide_field=True`` switches the sky generator to the buildsky-like
+    regime the hierarchical predict targets: ``nsources`` point sources
+    total, split over ``nclusters`` spatially compact blobs (Gaussian,
+    sigma ``cluster_scale``) whose centres fill a disc of diameter
+    ``fov`` direction-cosine units, with power-law (Pareto, index
+    ``flux_alpha``) fluxes above ``flux_min``.  Each blob is one
+    calibration direction with its own true Jones gains.  ``extent_m``
+    shrinks the station layout to the compact-array/all-sky geometry
+    (the default leaves it at the standard 3 km).  The default
+    (``wide_field=False``) path is bit-identical to what it was before
+    this knob existed — the wide branch only ever touches the RNG
+    stream after the shared uvw draw.
     """
     rng = np.random.default_rng(seed)
     data = make_visdata(
         nstations=nstations, tilesz=tilesz, nchan=nchan, freq0=freq0,
         chan_bw=chan_bw, dec0=dec0, seed=seed, dtype=dtype,
+        extent_m=extent_m,
     )
     jdtype = jnp.complex64 if dtype == np.float32 else jnp.complex128
 
@@ -132,6 +153,52 @@ def make_sky(
     tables: List[Optional[ShapeletTable]] = []
     true_flux: List[np.ndarray] = []
     true_si: List[np.ndarray] = []
+
+    if wide_field:
+        if shapelet_n0 > 0:
+            raise ValueError(
+                "wide_field skies are point-only (the hierarchical "
+                "predict contract); shapelet_n0 must be 0")
+        ncl = max(int(nclusters), 1)
+        # blob centres: uniform over a disc of diameter ``fov``
+        rr = 0.5 * fov * np.sqrt(rng.uniform(0.05, 1.0, ncl))
+        ang = rng.uniform(0.0, 2.0 * np.pi, ncl)
+        cx, cy = rr * np.cos(ang), rr * np.sin(ang)
+        counts = np.full(ncl, int(nsources) // ncl, np.int64)
+        counts[: int(nsources) % ncl] += 1
+        for k in range(ncl):
+            ns = int(counts[k])
+            ll = cx[k] + cluster_scale * rng.standard_normal(ns)
+            mm = cy[k] + cluster_scale * rng.standard_normal(ns)
+            # keep strictly inside the unit direction-cosine disc
+            r = np.sqrt(ll * ll + mm * mm)
+            shrink = np.where(r > 0.97, 0.97 / np.maximum(r, 1e-12), 1.0)
+            ll, mm = ll * shrink, mm * shrink
+            flux = flux_min * (1.0 + rng.pareto(flux_alpha, ns))
+            src = point_source_batch(
+                ll, mm, flux, f0=freq0, dtype=data.u.dtype)
+            si = np.zeros(ns)
+            if spectral:
+                si = rng.uniform(-0.9, -0.3, ns)
+                src = src.replace(spec_idx=jnp.asarray(si, data.u.dtype))
+            clusters.append(src)
+            tables.append(None)
+            true_flux.append(flux)
+            true_si.append(si)
+        M = len(clusters)
+        jones = random_jones(M, nstations, seed=seed + 1, amp=gain_amp,
+                             dtype=jdtype)
+        data = corrupt_and_observe(
+            data, clusters, jones=jones, noise_sigma=noise_sigma,
+            seed=seed + 2,
+        )
+        return SimulatedSky(
+            data=data, clusters=clusters, shapelet_tables=tables,
+            jones=jones, true_flux=true_flux, true_spec_idx=true_si,
+            true_modes=None, freq0=freq0, dec0=dec0,
+            noise_sigma=noise_sigma,
+        )
+
     for k in range(nclusters):
         ns = sources_per_cluster if k == 0 else 1
         ll = rng.uniform(-0.04, 0.04, ns)

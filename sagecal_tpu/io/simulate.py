@@ -64,10 +64,14 @@ def make_visdata(
     dec0: float = 0.9,
     seed: int = 0,
     dtype=np.float32,
+    extent_m: float = 3000.0,
 ) -> VisData:
-    """An empty (zero-visibility) tile with a consistent uvw track."""
+    """An empty (zero-visibility) tile with a consistent uvw track.
+
+    ``extent_m`` is the station-layout radius — compact values model
+    the dense-core / all-sky regime the wide-field workload targets."""
     ant_p, ant_q, time_idx = tile_baselines(nstations, tilesz)
-    xyz = station_layout(nstations, seed=seed)
+    xyz = station_layout(nstations, extent_m=extent_m, seed=seed)
     u, v, w = uvw_track(xyz, ant_p, ant_q, time_idx, dec0=dec0)
     rows = ant_p.shape[0]
     freqs = freq0 + chan_bw * (np.arange(nchan) - (nchan - 1) / 2.0)

@@ -894,13 +894,14 @@ GATE_HIGHER_BETTER = (
     "solves_per_sec_per_chip", "serve_batch_speedup",
     "admm_collective_bytes_reduction", "refine_outer_iters_per_sec",
     "stream_warm_speedup", "fleet_solves_per_sec_2workers",
+    "hier_predict_speedup",
 )
 GATE_LOWER_BETTER = (
     "xla_cost_analysis_bytes_accessed", "peak_device_memory_bytes",
     "compile_seconds_total", "coh_bf16_xla_cost_analysis_bytes_accessed",
     "serve_p50_latency_s", "admm_collective_bytes_per_round",
     "admm_straggler_ratio", "refine_flux_err",
-    "latency_to_first_solution_s",
+    "latency_to_first_solution_s", "hier_predict_max_rel_err",
 )
 # the metrics gated when present in BOTH records (others opt in via
 # --metric name=tol)
@@ -912,6 +913,7 @@ GATE_DEFAULT_METRICS = (
     "admm_collective_bytes_per_round", "admm_collective_bytes_reduction",
     "refine_flux_err", "refine_outer_iters_per_sec",
     "latency_to_first_solution_s", "fleet_solves_per_sec_2workers",
+    "hier_predict_speedup", "hier_predict_max_rel_err",
 )
 GATE_DEFAULT_TOLERANCE = 0.10
 

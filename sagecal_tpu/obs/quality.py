@@ -18,6 +18,10 @@ the solve returns on the host:
 - :func:`assess_consensus` — the ADMM side of the watchdog, reading the
   per-band residual trajectories that distributed/minibatch runs attach
   to their ``admm_round`` events.
+- :func:`check_hier_predict` — the hierarchical-sky-predict side of the
+  watchdog: gauges the sampled a-posteriori error of
+  ``predict_coherencies_hier`` and degrades the verdict when it
+  violates the configured (order, theta) error knob.
 - PPM heatmap writers + :func:`analyze_events` backing ``diag quality``.
 
 Nothing here imports jax; everything operates on materialized numpy
@@ -246,6 +250,61 @@ def check_and_emit(
             elog.emit("quality_degraded", reasons=reasons, **context)
     if log is not None and verdict != "ok":
         log(f"quality watchdog: {verdict} ({', '.join(reasons)})")
+    return verdict, reasons
+
+
+def check_hier_predict(
+    elog,
+    rel_err: float,
+    bound: float,
+    log=None,
+    **context,
+) -> Tuple[str, List[str]]:
+    """Watchdog hook for the hierarchical sky predict: verify the
+    sampled a-posteriori error of a ``predict_coherencies_hier`` call
+    against the configured error knob.
+
+    ``rel_err`` is the sampled relative error
+    (:func:`sagecal_tpu.sky.predict.sampled_error_estimate`);
+    ``bound`` is the knob it must stay under (the app's
+    ``hier_max_rel_err``, by default at least as large as the a-priori
+    Taylor bound of the chosen (order, theta)).  Emits a
+    ``hier_predict_check`` event, refreshes the
+    ``sagecal_hier_predict_error`` gauge, and escalates to a
+    ``quality_degraded`` event + watchdog counter when the knob is
+    violated (or the estimate went non-finite).  Returns
+    ``(verdict, reasons)`` — ``"ok"`` or ``"degraded"``; a violated
+    expansion never DIVERGES a run on its own (the solve watchdog
+    owns that verdict).
+    """
+    rel_err = float(rel_err)
+    bound = float(bound)
+    verdict, reasons = "ok", []
+    if not np.isfinite(rel_err):
+        verdict = "degraded"
+        reasons.append("hier predict error is non-finite")
+    elif rel_err > bound:
+        verdict = "degraded"
+        reasons.append(
+            f"hier predict sampled rel err {rel_err:.3e} exceeds "
+            f"bound {bound:.3e}")
+
+    reg = get_registry()
+    reg.gauge_set("sagecal_hier_predict_error",
+                  rel_err if np.isfinite(rel_err) else -1.0,
+                  help="sampled relative error of the latest "
+                       "hierarchical sky prediction vs exact")
+    if verdict != "ok":
+        reg.counter_inc("sagecal_quality_watchdog_total",
+                        help="watchdog escalations", verdict=verdict)
+
+    if elog is not None:
+        elog.emit("hier_predict_check", verdict=verdict, reasons=reasons,
+                  rel_err=rel_err, bound=bound, **context)
+        if verdict == "degraded":
+            elog.emit("quality_degraded", reasons=reasons, **context)
+    if log is not None and verdict != "ok":
+        log(f"hier predict watchdog: {verdict} ({', '.join(reasons)})")
     return verdict, reasons
 
 

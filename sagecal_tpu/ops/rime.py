@@ -258,6 +258,30 @@ def _shapelet_factor(c: SourceBatch, tab: ShapeletTable, u, v, w, freqs):
     return (2.0 * jnp.pi) * (a * b)[None, None, :] * sfac
 
 
+def resolve_source_flags(
+    src: SourceBatch, shapelets: Optional[ShapeletTable] = None,
+) -> tuple:
+    """Host-side resolution of the static predict flags
+    ``(has_extended, has_shapelet)`` from a CONCRETE source batch.
+
+    Callers that dispatch :func:`predict_coherencies` from inside a
+    trace (vmap / jit / grad) must resolve these once, host-side, on
+    the concrete template batch and pass them through explicitly —
+    the in-function probe cannot see a tracer's values and its
+    conservative fallback silently flips the static arguments
+    (= a recompile and the slow extended-source path).
+    """
+    stype_np = np.asarray(src.stype)
+    has_extended = bool(np.any(stype_np != ST_POINT))
+    has_shapelet = bool(np.any(stype_np == ST_SHAPELET))
+    if has_shapelet and shapelets is None:
+        raise ValueError(
+            "SourceBatch contains ST_SHAPELET sources but no ShapeletTable "
+            "was supplied — they would silently predict as point sources"
+        )
+    return has_extended, has_shapelet
+
+
 def predict_coherencies(
     u: jax.Array,
     v: jax.Array,
@@ -269,6 +293,9 @@ def predict_coherencies(
     shapelets: Optional[ShapeletTable] = None,
     tdelta: float = 0.0,
     dec0: float = 0.0,
+    *,
+    has_extended: Optional[bool] = None,
+    has_shapelet: Optional[bool] = None,
 ) -> jax.Array:
     """Sum of source coherencies on every baseline row: (F, 4, rows) complex
     (canonical flat layout, components [XX, XY, YX, YY] on axis -2).
@@ -285,16 +312,41 @@ def predict_coherencies(
 
     ``tdelta``/``dec0``: integration time (s) and field declination for
     time smearing (``time_smear``, predict.c:93-107); 0 disables.
+
+    ``has_extended``/``has_shapelet``: the STATIC source-type flags,
+    resolved once by the caller (:func:`resolve_source_flags`).  They
+    select the compiled program — flipping either is a recompile — so
+    any call site reachable from inside a trace must pass them
+    explicitly; the legacy in-function stype probe (deprecated) only
+    runs when they are left ``None`` and falls back to the
+    conservative extended path when ``stype`` is a tracer.
     """
-    # skip the extended-source math entirely for pure point-source batches
-    # (the overwhelmingly common case) when stype is concrete
-    try:
-        stype_np = np.asarray(src.stype)
-        has_extended = bool(np.any(stype_np != ST_POINT))
-        has_shapelet = bool(np.any(stype_np == ST_SHAPELET))
-    except (jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError):
-        has_extended = True
-        has_shapelet = shapelets is not None
+    if has_extended is None or has_shapelet is None:
+        # DEPRECATED probe: behavior depends on trace context (a tracer
+        # stype silently selects the conservative flags = a different
+        # compiled program than the same call made eagerly).  Kept only
+        # for callers that always run host-side on concrete batches.
+        try:
+            stype_np = np.asarray(src.stype)
+            probed_ext = bool(np.any(stype_np != ST_POINT))
+            probed_sh = bool(np.any(stype_np == ST_SHAPELET))
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            import warnings
+
+            warnings.warn(
+                "predict_coherencies called under a trace without explicit "
+                "has_extended/has_shapelet: falling back to the conservative "
+                "extended-source program (a silent recompile vs the eager "
+                "call).  Resolve the flags host-side with "
+                "resolve_source_flags and pass them through.",
+                DeprecationWarning, stacklevel=2)
+            probed_ext = True
+            probed_sh = shapelets is not None
+        if has_extended is None:
+            has_extended = probed_ext
+        if has_shapelet is None:
+            has_shapelet = probed_sh
     if shapelets is None:
         if has_shapelet:
             raise ValueError(

@@ -107,15 +107,25 @@ def cluster_coherencies(problem: RefineProblem, theta: jnp.ndarray):
     """(M, F, 4, rows) complex coherency stack recomputed from the free
     sky parameters — the differentiable analog of
     ``solvers.sage.build_cluster_data``'s precomputed ``coh``."""
+    from sagecal_tpu.ops.rime import resolve_source_flags
+
     clusters, tables = problem.spec.apply(
         theta, problem.clusters, problem.tables)
     d = problem.data
     cohs = []
     for ci, src in enumerate(clusters):
         tab = tables[ci] if tables is not None else None
+        # static flags from the CONCRETE template batch — under the
+        # outer-loop trace `src` carries tracers and the in-function
+        # probe would silently flip to the extended-source program
+        tmpl_tab = (problem.tables[ci]
+                    if problem.tables is not None else None)
+        has_ext, has_sh = resolve_source_flags(
+            problem.clusters[ci], tmpl_tab)
         cohs.append(predict_coherencies(
             d.u, d.v, d.w, d.freqs, src, problem.fdelta,
             problem.source_chunk, shapelets=tab,
+            has_extended=has_ext, has_shapelet=has_sh,
         ))
     return jnp.stack(cohs, axis=0)
 

@@ -1,0 +1,45 @@
+"""Hierarchical sky prediction: tree-clustered far-field coherencies
+for wide fields (ROADMAP item 4).
+
+Public surface:
+
+- :func:`sagecal_tpu.sky.predict.predict_coherencies_hier` — drop-in,
+  differentiable variant of ``ops.rime.predict_coherencies`` with an
+  (order, theta) error knob;
+- :func:`sagecal_tpu.sky.predict.build_hier_plan` /
+  :class:`sagecal_tpu.sky.predict.HierPlan` — the host-side routing
+  reused across calls;
+- :func:`sagecal_tpu.sky.predict.sampled_error_estimate` — the
+  a-posteriori check the quality watchdog gauges;
+- :func:`sagecal_tpu.sky.farfield.apriori_rel_bound` — the analytic
+  truncation bound;
+- :func:`sagecal_tpu.sky.tree.build_source_tree` /
+  :func:`sagecal_tpu.sky.tree.partition_by_tree` — host-side tree and
+  the effective-cluster collapse for the widefield workload.
+"""
+
+from sagecal_tpu.sky.farfield import apriori_rel_bound
+from sagecal_tpu.sky.predict import (
+    HierPlan,
+    build_hier_plan,
+    gather_sources,
+    predict_coherencies_hier,
+    sampled_error_estimate,
+)
+from sagecal_tpu.sky.tree import (
+    SourceTree,
+    build_source_tree,
+    partition_by_tree,
+)
+
+__all__ = [
+    "HierPlan",
+    "SourceTree",
+    "apriori_rel_bound",
+    "build_hier_plan",
+    "build_source_tree",
+    "gather_sources",
+    "partition_by_tree",
+    "predict_coherencies_hier",
+    "sampled_error_estimate",
+]

@@ -1,0 +1,74 @@
+"""Near-field (non-admissible) exact prediction on gathered subsets.
+
+The routing (:func:`sagecal_tpu.sky.tree.route_tiles`) leaves every
+(node, baseline-tile) pair that fails the well-separation criterion as
+a per-tile list of SOURCE indices.  This module gathers those subsets
+into one fixed-shape batched :class:`~sagecal_tpu.ops.rime.SourceBatch`
+(tiles x max_near, zero-flux padded) and routes them through the
+EXISTING exact predict — same phase/smear/spectral math, same
+gradients — vmapped over tiles.
+
+Padding contract: a padded slot gathers source 0 but multiplies every
+Stokes flux by the 0/1 validity mask, which makes it an EXACT no-op in
+the coherency contraction (the same invariant pad_source_batch relies
+on); ``f0`` is pinned to the gathered (positive) value so the spectral
+log never sees 0.  tests/test_sky_hier.py pins the exactly-zero
+contribution.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sagecal_tpu.ops.rime import SourceBatch, predict_coherencies
+
+
+def gather_near_batch(
+    src: SourceBatch,
+    near_src: jax.Array,     # (T, Nmax) source ids, 0-padded
+    near_valid: jax.Array,   # (T, Nmax) 0/1
+) -> SourceBatch:
+    """Batched per-tile near-field SourceBatch: every field (T, Nmax).
+
+    Differentiable in the source parameters (plain gathers); the
+    validity mask zeroes the padded slots' fluxes only — positions and
+    shape parameters ride along untouched so dtypes/invariants hold.
+    """
+    g = jax.tree_util.tree_map(lambda x: x[near_src], src)
+    val = near_valid.astype(src.sI0.dtype)
+    ival = near_valid.astype(jnp.int32)
+    return g.replace(
+        sI0=g.sI0 * val, sQ0=g.sQ0 * val, sU0=g.sU0 * val,
+        sV0=g.sV0 * val,
+        # padded slots are plain points regardless of the gathered type
+        stype=g.stype * ival,
+        shapelet_idx=jnp.where(near_valid > 0, g.shapelet_idx, -1),
+    )
+
+
+def near_field_tiles(
+    u_t: jax.Array,          # (T, R) tiled rows, seconds
+    v_t: jax.Array,
+    w_t: jax.Array,
+    freqs: jax.Array,
+    src: SourceBatch,
+    near_src: jax.Array,
+    near_valid: jax.Array,
+    fdelta: float = 0.0,
+    source_chunk: int = 32,
+) -> jax.Array:
+    """Near-field coherencies per tile: (T, F, 4, R) complex.
+
+    One vmapped exact predict over the gathered subsets.  The static
+    source-type flags are passed explicitly (the satellite-2 contract:
+    under this vmap the legacy stype probe would silently flip to the
+    conservative extended-source program)."""
+    batch = gather_near_batch(src, near_src, near_valid)
+
+    def one(u, v, w, s):
+        return predict_coherencies(
+            u, v, w, freqs, s, fdelta, source_chunk,
+            has_extended=False, has_shapelet=False)
+
+    return jax.vmap(one)(u_t, v_t, w_t, batch)

@@ -178,11 +178,16 @@ def build_cluster_data(
                 data, [clusters[i] for i in plain_idx],
                 [nchunks[i] for i in plain_idx], fdelta,
             ) if plain_idx else None
+            from sagecal_tpu.ops.rime import resolve_source_flags
+
             coh_parts = {}
             for i in shap_idx:
+                has_ext, has_sh = resolve_source_flags(
+                    clusters[i], shapelets)
                 coh_parts[i] = predict_coherencies(
                     data.u, data.v, data.w, data.freqs, clusters[i],
                     fdelta, shapelets=shapelets,
+                    has_extended=has_ext, has_shapelet=has_sh,
                 )
             for j, i in enumerate(plain_idx):
                 coh_parts[i] = plain_cd.coh[j]
@@ -250,10 +255,14 @@ def build_cluster_data(
             )
         coh = jnp.concatenate(parts, axis=0)
     else:
+        from sagecal_tpu.ops.rime import resolve_source_flags
+
+        flags = [resolve_source_flags(src, shapelets) for src in clusters]
         coh = jnp.stack([
             predict_coherencies(data.u, data.v, data.w, data.freqs, src,
-                                fdelta, shapelets=shapelets)
-            for src in clusters
+                                fdelta, shapelets=shapelets,
+                                has_extended=he, has_shapelet=hs)
+            for src, (he, hs) in zip(clusters, flags)
         ])
     cmaps = []
     for nch in nchunks:

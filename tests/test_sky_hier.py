@@ -1,0 +1,403 @@
+"""Hierarchical sky prediction (sagecal_tpu/sky/): tree invariants,
+far-field truncation error vs the a-priori Taylor bound, exact-fallback
+parity, gradient parity through the plan, near-field padding no-ops,
+and the satellite-2 explicit source-type-flag contract (zero recompile,
+deprecated probe fallback).
+
+Geometry note: the far-field error assertions need a regime where the
+expansion is ACTIVE and its truncation error is non-trivial — a compact
+(60 m) low-frequency (30 MHz) array observing a clustered wide field,
+the buildsky/all-sky regime the subsystem targets.  At the standard
+3 km / 150 MHz geometry nothing is admissible and everything routes
+near-field (also covered, as the exact-parity case).
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+import numpy as np
+import pytest
+
+from sagecal_tpu.data.simsky import make_sky
+from sagecal_tpu.io.simulate import make_visdata
+from sagecal_tpu.obs.perf import perf_stats
+from sagecal_tpu.obs.registry import telemetry
+from sagecal_tpu.ops.rime import (
+    point_source_batch,
+    predict_coherencies,
+    resolve_source_flags,
+)
+from sagecal_tpu.sky import (
+    apriori_rel_bound,
+    build_hier_plan,
+    build_source_tree,
+    partition_by_tree,
+    predict_coherencies_hier,
+    sampled_error_estimate,
+)
+from sagecal_tpu.sky.nearfield import gather_near_batch, near_field_tiles
+from sagecal_tpu.sky.tree import route_tiles
+
+pytestmark = pytest.mark.sky
+
+
+def _wide_sky(S=900, nblobs=8, sigma=0.004, fov=1.1, seed=3,
+              polarized=False):
+    """Clustered point sky over a wide field (direction cosines)."""
+    rng = np.random.default_rng(seed)
+    cl = rng.integers(0, nblobs, S)
+    cx = rng.uniform(-0.5 * fov, 0.5 * fov, nblobs)
+    cy = rng.uniform(-0.5 * fov, 0.5 * fov, nblobs)
+    ll = cx[cl] + rng.normal(0, sigma, S)
+    mm = cy[cl] + rng.normal(0, sigma, S)
+    keep = ll * ll + mm * mm < 0.9
+    ll, mm = ll[keep], mm[keep]
+    flux = 0.1 * rng.pareto(2.0, ll.size) + 0.05
+    src = point_source_batch(ll, mm, flux, f0=30e6, dtype=np.float64)
+    if polarized:
+        q = 0.1 * flux * rng.uniform(-1, 1, ll.size)
+        u_ = 0.05 * flux * rng.uniform(-1, 1, ll.size)
+        src = src.replace(sQ0=jnp.asarray(q), sU0=jnp.asarray(u_))
+    return src
+
+
+def _compact_obs(nstations=20, nchan=1):
+    return make_visdata(nstations=nstations, tilesz=2, nchan=nchan,
+                        freq0=30e6, seed=1, dtype=np.float64,
+                        extent_m=60.0)
+
+
+def _exact(d, src):
+    return np.asarray(predict_coherencies(
+        d.u, d.v, d.w, d.freqs, src, 0.0, 32,
+        has_extended=False, has_shapelet=False))
+
+
+# ------------------------------------------------------------- tree
+
+
+def test_tree_invariants():
+    src = _wide_sky(S=500)
+    ll = np.asarray(src.ll)
+    mm = np.asarray(src.mm)
+    nn = np.asarray(src.nn)
+    tree = build_source_tree(ll, mm, nn, leaf_size=16)
+    S = ll.shape[0]
+    pos = np.stack([ll, mm, nn], axis=1)
+
+    assert tree.nsources == S
+    # every level assigns every source to exactly one in-range node
+    for lev in range(tree.depth + 1):
+        ids = tree.node_of_source[lev]
+        lo, hi = tree.level_offset[lev], tree.level_offset[lev + 1]
+        assert np.all((ids >= lo) & (ids < hi))
+    # node counts at each level sum to S; radii cover their members
+    for lev in range(tree.depth + 1):
+        lo, hi = tree.level_offset[lev], tree.level_offset[lev + 1]
+        assert int(tree.node_count[lo:hi].sum()) == S
+        ids = tree.node_of_source[lev]
+        d = np.linalg.norm(pos - tree.node_center[ids], axis=1)
+        assert np.all(d <= tree.node_radius[ids] + 1e-12)
+    # leaf membership lists partition the sources
+    assert np.array_equal(np.sort(tree.perm), np.arange(S))
+    for leaf in range(4 ** tree.depth):
+        s0 = tree.leaf_start[leaf]
+        members = tree.perm[s0:s0 + tree.leaf_count[leaf]]
+        flat = tree.level_offset[tree.depth] + leaf
+        assert np.all(tree.node_of_source[tree.depth][members] == flat)
+
+
+def test_partition_by_tree_covers_all_sources():
+    src = _wide_sky(S=400)
+    tree = build_source_tree(np.asarray(src.ll), np.asarray(src.mm),
+                             np.asarray(src.nn), leaf_size=16)
+    for k in (1, 3, 8):
+        groups = partition_by_tree(tree, k)
+        assert len(groups) <= k
+        allidx = np.concatenate(groups)
+        assert np.array_equal(np.sort(allidx), np.arange(tree.nsources))
+
+
+def test_routing_theta_nonpositive_forces_near():
+    src = _wide_sky(S=200)
+    tree = build_source_tree(np.asarray(src.ll), np.asarray(src.mm),
+                             np.asarray(src.nn), leaf_size=16)
+    d = _compact_obs(nstations=8)
+    r = route_tiles(tree, np.asarray(d.u), np.asarray(d.v),
+                    np.asarray(d.w), 30e6, theta=-1.0)
+    assert r.far_pairs == 0
+    assert int(r.near_valid.sum()) == tree.nsources * r.ntiles
+
+
+# ------------------------------------- far-field error vs the bound
+
+
+def test_error_below_apriori_bound_and_monotone_in_order():
+    src = _wide_sky()
+    d = _compact_obs()
+    exact = _exact(d, src)
+    scale = np.max(np.abs(exact))
+
+    theta = 1.5
+    errs = []
+    plan = None
+    for p in (2, 4, 6):
+        coh, plan = predict_coherencies_hier(
+            d.u, d.v, d.w, d.freqs, src, order=p, theta=theta,
+            return_plan=True, plan=plan)
+        err = float(np.max(np.abs(np.asarray(coh) - exact)) / scale)
+        assert err <= apriori_rel_bound(p, theta), (p, err)
+        errs.append(err)
+    # the far field must actually be exercised, and the truncation
+    # error must be non-trivial at p=2, or this test proves nothing
+    assert plan.routing.far_pairs > 0
+    assert errs[0] > 1e-8
+    assert errs[0] > errs[1] > errs[2]
+
+    # a-posteriori sampled estimate agrees with the dense error
+    est = sampled_error_estimate(
+        d.u, d.v, d.w, d.freqs, src,
+        predict_coherencies_hier(d.u, d.v, d.w, d.freqs, src,
+                                 order=6, theta=theta, plan=plan),
+        nsample=64)
+    assert est["rel_err"] <= apriori_rel_bound(6, theta)
+
+
+def test_default_knob_meets_1e3() -> None:
+    """The acceptance knob: defaults (order=8, theta=1.5) keep both
+    the a-priori bound and the sampled error under 1e-3."""
+    assert apriori_rel_bound(8, 1.5) < 1e-3
+    src = _wide_sky()
+    d = _compact_obs()
+    coh = predict_coherencies_hier(d.u, d.v, d.w, d.freqs, src)
+    est = sampled_error_estimate(d.u, d.v, d.w, d.freqs, src, coh,
+                                 nsample=48)
+    assert est["rel_err"] <= 1e-3
+
+
+def test_all_near_matches_exact():
+    """theta <= 0 routes everything through the exact near-field path:
+    parity up to summation-order roundoff."""
+    src = _wide_sky(S=300)
+    d = _compact_obs(nstations=10)
+    exact = _exact(d, src)
+    coh = predict_coherencies_hier(d.u, d.v, d.w, d.freqs, src,
+                                   theta=-1.0)
+    np.testing.assert_allclose(np.asarray(coh), exact, rtol=0,
+                               atol=1e-10 * np.max(np.abs(exact)))
+
+
+def test_polarized_sky_full_stokes_path():
+    src = _wide_sky(polarized=True)
+    d = _compact_obs()
+    exact = _exact(d, src)
+    coh, plan = predict_coherencies_hier(
+        d.u, d.v, d.w, d.freqs, src, order=6, theta=1.5,
+        return_plan=True)
+    assert plan.npol == 4
+    err = np.max(np.abs(np.asarray(coh) - exact)) / np.max(np.abs(exact))
+    assert err <= apriori_rel_bound(6, 1.5)
+    # XY/YX must carry the linear polarization (nonzero off-diagonals)
+    assert np.max(np.abs(np.asarray(coh)[:, 1])) > 0
+
+
+def test_unpolarized_plan_selects_npol1():
+    src = _wide_sky()
+    d = _compact_obs(nstations=10)
+    plan = build_hier_plan(d.u, d.v, d.w, d.freqs, src)
+    assert plan.npol == 1
+    forced = build_hier_plan(d.u, d.v, d.w, d.freqs, src,
+                             force_polarized=True)
+    assert forced.npol == 4
+    c1 = predict_coherencies_hier(d.u, d.v, d.w, d.freqs, src, plan=plan)
+    c4 = predict_coherencies_hier(d.u, d.v, d.w, d.freqs, src,
+                                  plan=forced)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c4),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_rejects_non_point_batches():
+    src = _wide_sky(S=50)
+    src = src.replace(stype=src.stype.at[0].set(1))
+    d = _compact_obs(nstations=6)
+    with pytest.raises(ValueError, match="point-source"):
+        build_hier_plan(d.u, d.v, d.w, d.freqs, src)
+
+
+# --------------------------------------------------------- gradients
+
+
+def test_gradient_parity_vs_exact():
+    """d loss / d sI0 through the hierarchical predict matches the
+    exact predict's gradient to 1e-3 relative (the refine-adoption
+    requirement)."""
+    src = _wide_sky(S=400)
+    d = _compact_obs(nstations=12)
+    plan = build_hier_plan(d.u, d.v, d.w, d.freqs, src, theta=1.5)
+    assert plan.routing.far_pairs > 0
+
+    target = jnp.asarray(_exact(d, src)) * 1.02
+
+    def loss_hier(flux):
+        coh = predict_coherencies_hier(
+            d.u, d.v, d.w, d.freqs, src.replace(sI0=flux),
+            order=6, theta=1.5, plan=plan)
+        return jnp.sum(jnp.abs(coh - target) ** 2)
+
+    def loss_exact(flux):
+        coh = predict_coherencies(
+            d.u, d.v, d.w, d.freqs, src.replace(sI0=flux), 0.0, 32,
+            has_extended=False, has_shapelet=False)
+        return jnp.sum(jnp.abs(coh - target) ** 2)
+
+    g_h = np.asarray(jax.grad(loss_hier)(src.sI0))
+    g_e = np.asarray(jax.grad(loss_exact)(src.sI0))
+    assert np.all(np.isfinite(g_h))
+    rel = np.linalg.norm(g_h - g_e) / np.linalg.norm(g_e)
+    assert rel <= 1e-3, rel
+
+
+# --------------------------------------------------- near-field pads
+
+
+def test_padded_near_entries_exactly_zero():
+    src = _wide_sky(S=64)
+    d = _compact_obs(nstations=6)
+    rows = int(d.u.shape[0])
+    R = rows  # single tile
+    u_t = jnp.asarray(d.u)[None, :]
+    v_t = jnp.asarray(d.v)[None, :]
+    w_t = jnp.asarray(d.w)[None, :]
+
+    # all-invalid gather: the padded batch must contribute EXACTLY zero
+    near_src = jnp.zeros((1, 32), jnp.int32)
+    near_valid = jnp.zeros((1, 32), jnp.float64)
+    out = near_field_tiles(u_t, v_t, w_t, d.freqs, src, near_src,
+                           near_valid)
+    assert np.all(np.asarray(out) == 0.0)
+
+    # padding slots are inert: same valid set, different pad ids and
+    # different pad count give the bit-identical contribution
+    ids = jnp.asarray(np.arange(16), jnp.int32)
+    a_src = jnp.concatenate([ids, jnp.zeros(16, jnp.int32)])[None, :]
+    a_val = jnp.concatenate([jnp.ones(16), jnp.zeros(16)])[None, :]
+    b_src = jnp.concatenate([ids, jnp.full((48,), 63, jnp.int32)])[None, :]
+    b_val = jnp.concatenate([jnp.ones(16), jnp.zeros(48)])[None, :]
+    out_a = np.asarray(near_field_tiles(
+        u_t, v_t, w_t, d.freqs, src, a_src, a_val, 0.0, 16))
+    out_b = np.asarray(near_field_tiles(
+        u_t, v_t, w_t, d.freqs, src, b_src, b_val, 0.0, 16))
+    np.testing.assert_array_equal(out_a, out_b)
+
+    g = gather_near_batch(src, b_src, b_val)
+    assert np.all(np.asarray(g.sI0)[0, 16:] == 0.0)
+    assert np.all(np.asarray(g.shapelet_idx)[0, 16:] == -1)
+
+
+# ------------------------------- satellite 2: explicit static flags
+
+
+def test_resolve_source_flags():
+    src = _wide_sky(S=10)
+    assert resolve_source_flags(src) == (False, False)
+    ext = src.replace(stype=src.stype.at[3].set(1))
+    assert resolve_source_flags(ext) == (True, False)
+
+
+def test_explicit_flags_zero_recompile():
+    """Same shapes + same explicit flags must never recompile — even
+    when the concrete stype CONTENTS change (the silent-recompile
+    hazard the probe had)."""
+    d = make_visdata(nstations=5, tilesz=1, nchan=1, dtype=np.float64)
+    rng = np.random.default_rng(0)
+    # unique source count so no other test shares this compiled shape
+    S = 37
+    src = point_source_batch(rng.uniform(-0.01, 0.01, S),
+                             rng.uniform(-0.01, 0.01, S),
+                             rng.uniform(1, 2, S), dtype=np.float64)
+
+    def call(s):
+        return predict_coherencies(d.u, d.v, d.w, d.freqs, s, 0.0, 8,
+                                   has_extended=False, has_shapelet=False)
+
+    with telemetry(True):
+        call(src)
+        n0 = perf_stats()["predict_coherencies"]["compiles"]
+        call(src.replace(sI0=src.sI0 * 2.0))
+        call(src.replace(stype=src.stype.at[0].set(0)))  # same contents
+        assert perf_stats()["predict_coherencies"]["compiles"] == n0
+
+
+def test_probe_fallback_warns_and_stays_correct():
+    """Without explicit flags a traced stype falls back to the
+    conservative probe: a DeprecationWarning at trace time, identical
+    numbers."""
+    d = make_visdata(nstations=4, tilesz=1, nchan=1, dtype=np.float64)
+    rng = np.random.default_rng(1)
+    S = 23  # unique shape: the jit cache must miss so tracing happens
+    src = point_source_batch(rng.uniform(-0.01, 0.01, S),
+                             rng.uniform(-0.01, 0.01, S),
+                             rng.uniform(1, 2, S), dtype=np.float64)
+
+    @jax.jit
+    def traced(s):
+        return predict_coherencies(d.u, d.v, d.w, d.freqs, s, 0.0, 8)
+
+    with pytest.warns(DeprecationWarning, match="has_extended"):
+        out = traced(src)
+    ref = predict_coherencies(d.u, d.v, d.w, d.freqs, src, 0.0, 8,
+                              has_extended=False, has_shapelet=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-12, atol=1e-12)
+
+
+# ------------------------------------------------- widefield fixture
+
+
+def test_make_sky_wide_field_mode():
+    sky = make_sky(nstations=8, tilesz=1, nchan=1, nclusters=5, seed=9,
+                   dtype=np.float64, wide_field=True, nsources=203,
+                   freq0=30e6, extent_m=80.0, gain_amp=0.05)
+    assert len(sky.clusters) == 5
+    sizes = [int(c.ll.shape[0]) for c in sky.clusters]
+    assert sum(sizes) == 203
+    src = jtu.tree_map(lambda *xs: jnp.concatenate(xs), *sky.clusters)
+    ll, mm = np.asarray(src.ll), np.asarray(src.mm)
+    assert np.all(ll * ll + mm * mm < 1.0)
+    assert np.all(np.asarray(src.sI0) >= 0.05)
+    assert np.all(np.isfinite(np.asarray(sky.data.vis)))
+    with pytest.raises(ValueError, match="point-only"):
+        make_sky(wide_field=True, shapelet_n0=2)
+
+
+# ------------------------------------------------ widefield workload
+
+
+def test_widefield_app_end_to_end(tmp_path):
+    """The widefield workload wired end to end (apps/widefield.py):
+    tree-collapsed effective clusters through the hier predict into the
+    packed solver, per-tile watchdog verification, warm-start chain,
+    summary + solutions artifacts."""
+    from sagecal_tpu.apps.config import WidefieldConfig
+    from sagecal_tpu.apps.widefield import run_widefield
+
+    cfg = WidefieldConfig(
+        out_dir=str(tmp_path / "wf"), nstations=8, ntiles=2, tilesz=2,
+        nchan=1, nsources=120, nblobs=4, nclusters=2, freq0=30e6,
+        extent_m=60.0, seed=5, max_emiter=1, max_iter=1, max_lbfgs=2,
+        solver_mode=1)
+    summary = run_widefield(cfg, log=lambda *a: None)
+    assert summary["hier_watchdog_ok"] is True
+    assert summary["hier_max_rel_err"] is not None
+    assert summary["hier_max_rel_err"] < summary["apriori_bound"]
+    assert len(summary["tiles"]) == 2
+    assert summary["nclusters_eff"] == 2
+    sol = np.load(tmp_path / "wf" / "solutions.npz")
+    assert sol["gains"].shape[:2] == (2, 2)
+    assert int(sol["cluster_sizes"].sum()) == 120
+    assert np.all(np.isfinite(sol["gains"]))
+    # the solver moved off the identity start on every tile
+    for tile in summary["tiles"]:
+        assert tile["res_1"] <= tile["res_0"] * cfg.res_ratio

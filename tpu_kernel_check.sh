@@ -123,10 +123,10 @@ if probe; then
 fi
 echo "=== bf16-coherency fused bench"
 if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
-echo "=== telemetry+quality+trace+serve_obs+fleet+stream+protocol test pass (CPU, marker-driven)"
+echo "=== telemetry+quality+trace+serve_obs+fleet+stream+sky+protocol test pass (CPU, marker-driven)"
 JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 1200 \
   python -m pytest tests/ -q \
-  -m "telemetry or quality or trace or serve_obs or fleet or stream or protocol" \
+  -m "telemetry or quality or trace or serve_obs or fleet or stream or sky or protocol" \
   -p no:cacheprovider | tail -3
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then echo "telemetry test pass FAILED rc=$rc"; exit 1; fi
@@ -358,3 +358,37 @@ print("fleet smoke ok: 6/6 unique manifests complete after the kill")
 PY
 [ $? = 0 ] || { echo "fleet kill smoke FAILED"; exit 1; }
 rm -rf "$FLDIR"
+echo "=== widefield smoke (CPU, hier predict watchdog + kill-and-resume)"
+# the wide-field workload end to end: 300 sources collapsed to 3
+# tree-partitioned effective clusters, hierarchical coherencies
+# a-posteriori-verified by the quality watchdog on every tile, packed
+# solves warm-started down the tile chain.  Preemption path: SIGTERM
+# after the first tile checkpoint, --resume to completion, and the
+# resumed run's solutions must be BIT-EXACT against an uninterrupted
+# run (the per-tile fold_in key chain + checkpointed warm start make
+# resume == uninterrupted by construction)
+WFDIR=$(mktemp -d)
+WFRUN=(python -m sagecal_tpu.apps.cli widefield -n 10 --ntiles 3 -t 2
+       -S 300 --nblobs 6 -k 3 --nchan 1 --checkpoint-every 1)
+JAX_PLATFORMS=cpu timeout 480 "${WFRUN[@]}" --out-dir "$WFDIR/clean" \
+  || { echo "widefield clean run FAILED rc=$?"; exit 1; }
+JAX_PLATFORMS=cpu timeout 480 python -m sagecal_tpu.elastic.faultinject \
+  kill-at-ckpt 1 "$WFDIR/killed/widefield.ckpt" -- \
+  "${WFRUN[@]}" --out-dir "$WFDIR/killed" \
+  || { echo "widefield kill step FAILED"; exit 1; }
+JAX_PLATFORMS=cpu timeout 480 "${WFRUN[@]}" --out-dir "$WFDIR/killed" \
+  --resume || { echo "widefield resume FAILED rc=$?"; exit 1; }
+JAX_PLATFORMS=cpu timeout 60 python -c "
+import json
+import numpy as np
+s = json.load(open('$WFDIR/clean/widefield.json'))
+assert s['hier_watchdog_ok'] is True, s
+assert s['hier_max_rel_err'] < s['apriori_bound'], s
+a = np.load('$WFDIR/clean/solutions.npz')['gains']
+b = np.load('$WFDIR/killed/solutions.npz')['gains']
+np.testing.assert_array_equal(a, b)
+print('widefield smoke ok: %d tiles, sampled err %.2e < bound %.2e, '
+      'resume bit-exact' % (s['ntiles'], s['hier_max_rel_err'],
+                            s['apriori_bound']))" \
+  || { echo "widefield smoke validate FAILED"; exit 1; }
+rm -rf "$WFDIR"
