@@ -91,7 +91,9 @@ NCHAN = 2
 LBFGS_ITERS = 20
 REPEATS = 3
 
-V5E_BF16_PEAK_FLOPS = 197e12  # TPU v5e per-chip peak (bf16)
+# Device peaks live in sagecal_tpu/obs/roofline.py (PEAK_TABLE, keyed
+# by jax device_kind) — the bench looks its own hardware up instead of
+# assuming v5e, so a non-v5e backend never reports a silently-wrong MFU.
 
 # Cost path selector, resolved ONCE so run() and the JSON record can't
 # diverge: 1 = fused Pallas RIME kernel, 0 = XLA predict path.  Default
@@ -167,8 +169,11 @@ def make_step(data, cdata, nu=5.0):
 
     M, nchunk, n8 = NCLUSTERS, 1, 8 * NSTATIONS
 
+    # named so the lowered hlo_module ("jit_bench_step_xla") joins the
+    # note_compile ledger row in `diag roofline` — the devprof parser
+    # keys per-op device time by module name
     @jax.jit
-    def step(vis_ri, mask, coh_ri, p0):
+    def bench_step_xla(vis_ri, mask, coh_ri, p0):
         # true-f32 linear algebra (TPU f32 matmuls default to bf16 MXU
         # passes; the production solver runs HIGHEST — bench the same)
         with jax.default_matmul_precision("highest"):
@@ -191,7 +196,7 @@ def make_step(data, cdata, nu=5.0):
                             itmax=LBFGS_ITERS, M=7)
         return fit.p, fit.cost, fit.iterations
 
-    return step
+    return bench_step_xla
 
 
 def make_fused_step(data, nu=5.0, tile=None):
@@ -248,8 +253,9 @@ def make_fused_step(data, nu=5.0, tile=None):
                                  (0, rowsp - rows)))
         return vis_p, mask_p, coh_p, antp_d, antq_d
 
+    # named for the devprof trace <-> ledger join, like bench_step_xla
     @jax.jit
-    def step(vis_p, mask_p, coh_p, antp_d, antq_d, p0):
+    def bench_step_fused(vis_p, mask_p, coh_p, antp_d, antq_d, p0):
         # kernel dots are HIGHEST internally; this covers the LBFGS
         # two-loop/line-search vector algebra (production precision).
         # coh/vis/mask stop_gradient happens inside the chunked cost
@@ -267,7 +273,7 @@ def make_fused_step(data, nu=5.0, tile=None):
                             itmax=LBFGS_ITERS, M=7)
         return fit.p, fit.cost, fit.iterations
 
-    return prep, step
+    return prep, bench_step_fused
 
 
 def analytic_flops_per_cost_eval(tilesz=TILESZ):
@@ -352,6 +358,7 @@ def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ,
         args = (*prep(*args[:3]), args[3])
     else:
         step = make_step(data, cdata)
+    from sagecal_tpu.obs.devprof import device_profile
     from sagecal_tpu.obs.perf import device_memory_snapshot, note_compile
     from sagecal_tpu.utils.profiling import trace
 
@@ -386,8 +393,12 @@ def run(dtype=np.float32, repeats=REPEATS, want_flops=False, tilesz=TILESZ,
         except Exception:
             pass
     # SAGECAL_PROFILE_DIR additionally captures an XLA trace of the
-    # warm-up + timing loop (no-op when unset)
-    with trace():
+    # warm-up + timing loop (no-op when unset); SAGECAL_DEVICE_PROFILE /
+    # --device-profile captures the devprof trace our own roofline
+    # parser ingests (`diag roofline`).  Only one jax trace can be live
+    # — device_profile skips itself (with a flight note) when the
+    # TensorBoard trace already owns the profiler.
+    with trace(), device_profile():
         out = step(*args)  # compile (if not AOT) + first run
         iters = int(np.asarray(out[2]))  # host read = the only real sync
         times = []
@@ -1075,10 +1086,40 @@ def _latest_flight_dump():
         return os.path.abspath(cands[-1])
 
 
-def main():
+def _latest_devprof_trace():
+    """Newest device-profile trace: this process's capture if one
+    landed, else the newest trace under the configured capture dir (a
+    previous wedged run's forensics) — attached to the recovery event
+    alongside the flight dump."""
+    from sagecal_tpu.obs.devprof import last_trace_path, newest_trace_path
+
+    path = last_trace_path()
+    if path:
+        return os.path.abspath(path)
+    root = os.environ.get("SAGECAL_DEVICE_PROFILE")
+    if root and os.path.isdir(root):
+        found = newest_trace_path(root)
+        if found:
+            return os.path.abspath(found)
+    return None
+
+
+def main(argv=None):
+    import argparse
     import uuid
 
     import jax
+
+    ap = argparse.ArgumentParser(
+        prog="bench.py",
+        description="north-star LBFGS calibration bench + satellite rows")
+    ap.add_argument("--device-profile", default=None, metavar="DIR",
+                    help="capture a device-profiler trace of the timing "
+                         "loop into DIR for `diag roofline` (same as "
+                         "SAGECAL_DEVICE_PROFILE=DIR)")
+    args = ap.parse_args(argv)
+    if args.device_profile:
+        os.environ["SAGECAL_DEVICE_PROFILE"] = args.device_profile
 
     # persistent compile cache: a prior successful TPU compile (e.g. the
     # recovery watcher's banked run) makes later runs start in seconds.
@@ -1161,6 +1202,10 @@ def main():
     # pinned baseline.  run() resolves the FUSED default from the
     # device it targets.
     on_tpu = platform not in ("cpu",)
+    try:
+        device_kind = jax.devices()[0].device_kind
+    except Exception:
+        device_kind = None
     tilesz = TILESZ if on_tpu else 5
     repeats = REPEATS if on_tpu else 1
     with tracer.span("bench", kind="run", platform=platform,
@@ -1311,6 +1356,20 @@ def main():
     flops_per_sec = cost_evals * fl_eval / dt
     gbytes_per_sec = cost_evals * by_eval / dt / 1e9
 
+    # measured-vs-peak utilization against THIS hardware's peak-table
+    # entry (obs/roofline.py), not a hardcoded v5e constant; None when
+    # the device kind has no entry — an honest gap beats a wrong MFU
+    from sagecal_tpu.obs.devprof import last_trace_path
+    from sagecal_tpu.obs.evidence import (
+        bench_evidence_classes,
+        wallclock_evidence,
+    )
+    from sagecal_tpu.obs.roofline import bw_util as _roof_bw
+    from sagecal_tpu.obs.roofline import mfu as _roof_mfu
+
+    mfu_val = _roof_mfu(flops_per_sec, device_kind, dtype="bf16")
+    bw_val = _roof_bw(gbytes_per_sec * 1e9, device_kind)
+
     rec = {
         "metric": "lbfgs_cal_iters_per_sec",
         "value": round(value, 3),
@@ -1349,9 +1408,22 @@ def main():
         "recovery_attempted": recovery_attempted,
         "analytic_tflops_per_sec": round(flops_per_sec / 1e12, 4),
         "analytic_hbm_gb_per_sec": round(gbytes_per_sec, 1),
-        "mfu_vs_v5e_bf16_peak": round(flops_per_sec / V5E_BF16_PEAK_FLOPS, 5),
-        "bw_util_vs_v5e_819gbps": round(gbytes_per_sec / 819.0, 4),
+        "mfu_vs_device_peak": round(mfu_val, 5) if mfu_val else None,
+        "bw_util_vs_device_peak": round(bw_val, 4) if bw_val else None,
+        "device_kind": device_kind,
+        # evidence ledger (obs/evidence.py): the record-level class of
+        # the wall-clock rows + the per-metric override map for the
+        # satellite rows measured another way (AOT bytes/HLO, CPU
+        # subprocess harnesses) — what `diag gate` / bench_trend use to
+        # refuse cross-evidence comparisons
+        "evidence": wallclock_evidence(platform),
+        "evidence_classes": bench_evidence_classes(platform),
     }
+    dp_trace = last_trace_path()
+    if dp_trace:
+        # the devprof capture of this run's timing loop — feed it to
+        # `diag roofline` (flight dumps carry the same path)
+        rec["device_profile_trace"] = dp_trace
     if warm is not None:
         # elastic warm-start acceleration: gate-able, higher is better
         # (diag gate knows the direction via obs/perf.py)
@@ -1455,7 +1527,8 @@ def main():
             elog.emit("tpu_probe_failed", recovered=probe_ok)
         if recovery_attempted:
             elog.emit("tpu_recovery_attempted", succeeded=probe_ok,
-                      flight_dump=_latest_flight_dump())
+                      flight_dump=_latest_flight_dump(),
+                      device_profile_trace=_latest_devprof_trace())
         if not probe_ok or init_failed:
             elog.emit("fallback_to_cpu", platform=platform,
                       backend_init_failed=init_failed)

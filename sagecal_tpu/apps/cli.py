@@ -204,6 +204,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--checkpoint-dir", default=None,
                     help="checkpoint directory (default: "
                     "<solutions>.ckpt)")
+    # hardware-truth observability (obs/devprof.py)
+    ap.add_argument("--device-profile", default=None, metavar="DIR",
+                    help="capture a device-profiler trace of this run "
+                    "into DIR for `diag roofline` (same as "
+                    "SAGECAL_DEVICE_PROFILE=DIR)")
     return ap
 
 
@@ -339,8 +344,15 @@ def main(argv=None):
     from sagecal_tpu.obs.contracts import ContractViolation
     from sagecal_tpu.obs.quality import DivergenceAbort
 
+    # --device-profile DIR (or SAGECAL_DEVICE_PROFILE): capture a
+    # device-profiler trace of the whole dispatch for `diag roofline`;
+    # the CM stops the capture on ANY exit path, so even an aborted
+    # run leaves a parseable trace
+    from sagecal_tpu.obs.devprof import device_profile
+
     try:
-        return _dispatch(args, cfg)
+        with device_profile(args.device_profile):
+            return _dispatch(args, cfg)
     except DivergenceAbort as e:
         # --abort-on-divergence: the run already emitted its structured
         # run_aborted event; exit distinctly from argparse's 2

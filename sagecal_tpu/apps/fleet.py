@@ -97,6 +97,16 @@ def build_parser() -> argparse.ArgumentParser:
                     "admission control deadlines; falls back to a "
                     "'slos' key in the request manifest")
     ap.add_argument("-V", "--verbose", action="store_true")
+    ap.add_argument("--profile-worker", default="", metavar="WID",
+                    help="coordinator: arm worker WID for a one-cycle "
+                    "device-profile capture by dropping the devprof "
+                    "flag file in the shared out-dir — the targeted "
+                    "worker of a LIVE fleet profiles its next claimed "
+                    "cycle, no restart (obs/devprof.py; the retired "
+                    "flag's .done file records the trace path)")
+    ap.add_argument("--profile-dir", default="",
+                    help="capture directory for --profile-worker "
+                    "(default <out-dir>/devprof_<WID>)")
     return ap
 
 
@@ -198,6 +208,16 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
+    if args.profile_worker:
+        # drop the arm flag BEFORE any work starts, so a worker spawned
+        # by this very coordinator (or one already alive on the shared
+        # dir) sees it on its next claim
+        from sagecal_tpu.obs.devprof import arm_fleet_profile
+
+        path = arm_fleet_profile(cfg.out_dir, args.profile_worker,
+                                 args.profile_dir or None)
+        print(f"fleet: armed device profile for worker "
+              f"{args.profile_worker} ({path})")
     if cfg.role == "worker":
         if not (cfg.queue_dir or cfg.out_dir):
             build_parser().error("--queue-dir (or --out-dir) required")

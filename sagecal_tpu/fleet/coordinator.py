@@ -25,6 +25,7 @@ result set duplicate- and torn-free.
 
 from __future__ import annotations
 
+import glob
 import os
 import subprocess
 import sys
@@ -162,6 +163,23 @@ class FleetCoordinator:
                 return self.queue.all_done()
             time.sleep(poll_s)
 
+    def await_armed_profiles(self, grace_s: float = 30.0) -> None:
+        """A worker armed for device profiling (obs/devprof.py fleet
+        arming) flushes its trace and retires the arm flag to ``.done``
+        in a finally block after its profiled cycle — which is often
+        the cycle that drains the queue.  Terminating it mid-flush
+        loses the capture, so give live armed workers a short grace
+        window before shutdown.  Returns as soon as no un-retired flag
+        remains or every worker has exited on its own."""
+        deadline = self.clock() + grace_s
+        pat = os.path.join(self.cfg.out_dir, "device_profile_arm.*.json")
+        while self.clock() < deadline:
+            if not glob.glob(pat):
+                return
+            if not any(p.poll() is None for p in self.procs):
+                return
+            time.sleep(0.2)
+
     def shutdown(self, grace_s: float = 10.0) -> None:
         for p in self.procs:
             if p.poll() is None:
@@ -238,6 +256,7 @@ class FleetCoordinator:
         try:
             self.spawn_workers()
             drained = self.watch()
+            self.await_armed_profiles()
         finally:
             self.shutdown()
         summary = self.summary(requests)

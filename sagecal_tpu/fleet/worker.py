@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import signal
 import threading
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -44,6 +45,10 @@ from sagecal_tpu.fleet.queue import LeaseLost, LeaseQueue, WorkItem
 
 #: solve attempts per request before it is completed as an error
 MAX_ATTEMPTS = 3
+
+
+def _sigterm_to_exit(signum, frame):
+    raise SystemExit(143)
 
 
 def _request_from_item(item: WorkItem):
@@ -392,6 +397,19 @@ class FleetWorker:
     def run(self, elog=None) -> Dict[str, Any]:
         from sagecal_tpu.obs.registry import get_registry
 
+        # Coordinator shutdown sends SIGTERM the moment the queue
+        # drains; the default action kills the process without running
+        # finally blocks, which loses an in-flight device-profile flush
+        # (obs/devprof.py fleet arming) and leaves the arm flag
+        # un-retired.  Convert to SystemExit so cleanup runs.  Only
+        # possible from the main thread — in-process test harnesses
+        # driving run() from a worker thread keep default handling.
+        if threading.current_thread() is threading.main_thread():
+            try:
+                signal.signal(signal.SIGTERM, _sigterm_to_exit)
+            except (ValueError, OSError):
+                pass
+
         cfg, reg = self.cfg, get_registry()
         os.makedirs(cfg.out_dir, exist_ok=True)
         t0 = self.clock()
@@ -406,7 +424,36 @@ class FleetWorker:
                               n=len(claimed),
                               hint=claimed[0].bucket_hint,
                               ids=[it.request_id for it in claimed])
-                self.process(claimed, elog=elog)
+                # coordinator-armed device profiling (obs/devprof.py):
+                # when the arm flag for THIS worker sits in the shared
+                # out_dir, capture exactly one claimed cycle, then
+                # retire the flag to .done with the trace path — one
+                # worker of a live fleet gets profiled, no restart
+                from sagecal_tpu.obs.devprof import (
+                    check_fleet_arm,
+                    complete_fleet_arm,
+                    start_device_profile,
+                    stop_device_profile,
+                )
+
+                arm = check_fleet_arm(cfg.out_dir, self.wid)
+                if arm is not None:
+                    started = start_device_profile(arm["profile_dir"])
+                    try:
+                        self.process(claimed, elog=elog)
+                    finally:
+                        trace_path = (stop_device_profile()
+                                      if started else None)
+                        # retire the flag even when the profiler was
+                        # busy — a failing capture must not re-arm
+                        # itself every cycle
+                        complete_fleet_arm(arm, trace_path)
+                        if elog is not None:
+                            elog.emit("fleet_worker_profiled",
+                                      worker=self.wid,
+                                      trace_path=trace_path)
+                else:
+                    self.process(claimed, elog=elog)
                 continue
             if self.queue.all_done():
                 break

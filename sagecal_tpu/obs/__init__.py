@@ -23,6 +23,14 @@ Four pieces (SURVEY section 5 "observability"):
 - :mod:`sagecal_tpu.obs.flight` — in-process flight recorder
   (``SAGECAL_FLIGHT=1``): bounded activity ring, heartbeat file, hang
   watchdog, and crash handlers dumping all-thread stacks.
+- :mod:`sagecal_tpu.obs.devprof` — device-profiler capture
+  (``SAGECAL_DEVICE_PROFILE=dir`` / ``--device-profile``), the
+  zero-dependency trace parser, and per-kernel-family attribution.
+- :mod:`sagecal_tpu.obs.roofline` — per-``device_kind`` peak table,
+  arithmetic-intensity classification, per-kernel MFU/BW-util.
+- :mod:`sagecal_tpu.obs.evidence` — evidence classes (tpu-wallclock /
+  cpu-wallclock / aot-bytes / aot-hlo) stamped on every banked metric;
+  the gate/trend cross-evidence refusal logic.
 - :mod:`sagecal_tpu.obs.diag` — the ``sagecal-tpu diag`` CLI.
 
 This package root imports neither jax nor numpy (obs.perf defers its
@@ -96,6 +104,27 @@ from sagecal_tpu.obs.aggregate import (  # noqa: F401
     quantile_bounds_from_state,
     read_metrics_snapshots,
     write_metrics_snapshot,
+)
+from sagecal_tpu.obs.devprof import (  # noqa: F401
+    attribute_trace,
+    classify_kernel,
+    device_profile,
+    last_trace_path,
+    read_trace_events,
+    start_device_profile,
+    stop_device_profile,
+)
+from sagecal_tpu.obs.evidence import (  # noqa: F401
+    EVIDENCE_CLASSES,
+    metric_evidence,
+    record_evidence,
+    wallclock_evidence,
+)
+from sagecal_tpu.obs.roofline import (  # noqa: F401
+    PEAK_TABLE,
+    bw_util,
+    lookup_peaks,
+    mfu,
 )
 from sagecal_tpu.obs.slo import (  # noqa: F401
     SLOMonitor,
@@ -188,4 +217,19 @@ __all__ = [
     "evaluate_results",
     "format_slo_report",
     "load_slo_specs",
+    "attribute_trace",
+    "classify_kernel",
+    "device_profile",
+    "last_trace_path",
+    "read_trace_events",
+    "start_device_profile",
+    "stop_device_profile",
+    "EVIDENCE_CLASSES",
+    "metric_evidence",
+    "record_evidence",
+    "wallclock_evidence",
+    "PEAK_TABLE",
+    "bw_util",
+    "lookup_peaks",
+    "mfu",
 ]

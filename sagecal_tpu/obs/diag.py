@@ -23,7 +23,25 @@ Subcommands:
   perf-regression gate: compare a fresh bench JSON against the pinned
   baseline with per-metric tolerances and direction semantics
   (throughput dropping or bytes/memory rising beyond tolerance fails).
-  Exit 1 on any regression or when nothing is comparable.
+  Exit 1 on any regression or when nothing is comparable; exit 2
+  (REFUSED) on an evidence-class mismatch between the records — a
+  cpu-wallclock run cannot prove or regress tpu-wallclock pins
+  (``--strict`` forces the comparison; per-metric mismatches are
+  excluded with a printed note).
+
+- ``roofline TRACE [--events LOG] [--device-kind K]`` — per-kernel-
+  family roofline attribution from a device-profile capture
+  (:mod:`sagecal_tpu.obs.devprof`): measured device time per family,
+  MFU / HBM-BW-util against the :mod:`sagecal_tpu.obs.roofline` peak
+  table, compute- vs memory-bound classification, dispatch-gap stats,
+  and the ROADMAP-item-1 lever each family implicates.  Exit 1 when
+  the trace holds no device-op events.
+
+- ``evidence [RECORD] [--history FILE]`` — the evidence-class ledger:
+  every gate-able metric of a bench record with its class
+  (tpu-wallclock / cpu-wallclock / aot-bytes / aot-hlo) and whether
+  the claim is wall-clock-proven or AOT-proven.  Exit 1 on any
+  unclassified claim (the machine check behind ROADMAP:34-36).
 
 - ``quality FILE [--out-dir DIR]`` — calibration-quality report from a
   run's ``solve_quality`` / ``admm_round`` events: per-station and
@@ -266,11 +284,21 @@ def _cmd_gate(args) -> int:
         which = args.new if new is None else args.baseline
         print(f"{which}: no bench record found", file=sys.stderr)
         return 1
-    p_new, p_base = new.get("platform"), base.get("platform")
-    if p_new and p_base and p_new != p_base and not args.strict:
-        print(f"gate: SKIP — platform mismatch ({p_new} vs baseline "
-              f"{p_base}); rerun with --strict to compare anyway")
-        return 0
+    from sagecal_tpu.obs.evidence import metric_evidence, record_evidence
+    from sagecal_tpu.obs.perf import GATE_DEFAULT_METRICS
+
+    # evidence refusal (PR 16): a record proven one way must never gate
+    # against pins proven another — the old platform-mismatch SKIP
+    # (exit 0) let a CPU-fallback run silently "pass" the TPU gate.
+    # REFUSE loudly instead; --strict still forces the comparison.
+    ev_new, ev_base = record_evidence(new), record_evidence(base)
+    if ev_new and ev_base and ev_new != ev_base and not args.strict:
+        print(f"gate: REFUSED — evidence-class mismatch (new {ev_new} "
+              f"vs baseline {ev_base}): a {ev_new} measurement cannot "
+              f"prove or regress a {ev_base} claim; re-bench on matching "
+              f"hardware, or rerun with --strict to force the comparison",
+              file=sys.stderr)
+        return 2
     tolerances = {}
     for spec in args.metric or []:
         name, _, tol = spec.partition("=")
@@ -280,8 +308,27 @@ def _cmd_gate(args) -> int:
             print(f"bad --metric spec: {spec!r} (want name=tol)",
                   file=sys.stderr)
             return 2
+    # per-metric refusal: satellite metrics carry their own class in
+    # the `evidence_classes` override map (an aot-hlo bytes row rides a
+    # tpu-wallclock headline); drop — with a printed note — any metric
+    # whose classes resolve on both sides and differ
+    names = list(GATE_DEFAULT_METRICS)
+    for extra in tolerances:
+        if extra not in names:
+            names.append(extra)
+    kept = []
+    for m in names:
+        a, b = metric_evidence(new, m), metric_evidence(base, m)
+        if a and b and a != b and not args.strict:
+            if m in new and m in base:
+                print(f"gate: metric {m} excluded — evidence-class "
+                      f"mismatch ({a} vs baseline {b})")
+            tolerances.pop(m, None)
+            continue
+        kept.append(m)
     failures, rows = gate_compare(new, base, tolerances=tolerances,
-                                  default_tol=args.tol)
+                                  default_tol=args.tol,
+                                  metrics=tuple(kept))
     print(format_gate_report(rows, failures))
     for fail in failures:
         print(f"REGRESSION: {fail}", file=sys.stderr)
@@ -290,6 +337,124 @@ def _cmd_gate(args) -> int:
         # silently pass because a record lost its metrics
         return 1
     return 1 if failures else 0
+
+
+def _cmd_roofline(args) -> int:
+    import os
+
+    from sagecal_tpu.obs.devprof import (
+        attribute_trace,
+        ledger_from_events,
+        newest_trace_path,
+    )
+    from sagecal_tpu.obs.roofline import (
+        build_report,
+        format_report,
+        set_kernel_gauges,
+    )
+
+    path = args.trace
+    if os.path.isdir(path):
+        found = newest_trace_path(path)
+        if not found:
+            print(f"{path}: no *.trace.json[.gz] under it — was the "
+                  f"capture armed (SAGECAL_DEVICE_PROFILE / "
+                  f"--device-profile)?", file=sys.stderr)
+            return 1
+        path = found
+    attribution = attribute_trace(path,
+                                  gap_threshold_us=args.gap_threshold_us)
+    if not attribution["n_op_events"]:
+        print(f"{path}: no device-op events (ph=X with args.hlo_op or "
+              f"on an 'XLA Ops' track) — not a device-profile trace?",
+              file=sys.stderr)
+        return 1
+    ledger = ledger_from_events(args.events) if args.events else {}
+    kind = args.device_kind
+    if kind is None:
+        # the trace itself is device-agnostic; ask the live backend
+        # (guarded: parsing a TPU trace on a laptop is legitimate)
+        try:
+            import jax
+
+            kind = jax.devices()[0].device_kind
+        except Exception:
+            kind = None
+    report = build_report(attribution, ledger, kind, dtype=args.dtype)
+    set_kernel_gauges(report)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True, default=float))
+    else:
+        print(f"trace: {path}")
+        print(format_report(report))
+    return 0
+
+
+def _cmd_evidence(args) -> int:
+    from sagecal_tpu.obs.evidence import (
+        is_valid,
+        metric_evidence,
+        proof_kind,
+        record_evidence,
+    )
+    from sagecal_tpu.obs.perf import (
+        GATE_DEFAULT_METRICS,
+        GATE_HIGHER_BETTER,
+        GATE_LOWER_BETTER,
+        read_bench_history,
+    )
+
+    rec = _load_record(args.record)
+    if rec is None:
+        print(f"{args.record}: no bench record found", file=sys.stderr)
+        return 1
+    # the banked claims = every gate-able metric present in the record
+    # (gate direction tables + defaults); config fields like
+    # serve_batch_width are not claims and carry no class
+    names = []
+    for m in (*GATE_HIGHER_BETTER, *GATE_LOWER_BETTER,
+              *GATE_DEFAULT_METRICS):
+        if m in rec and m not in names:
+            names.append(m)
+    rc = 0
+    ev_rec = record_evidence(rec)
+    print(f"{args.record}: record-level evidence "
+          f"{ev_rec or 'UNCLASSIFIED'}")
+    w = max((len(m) for m in names), default=8) + 2
+    print(f"{'metric':<{w}}{'value':>14}  {'evidence':<15}proof")
+    counts = {}
+    for m in names:
+        ev = metric_evidence(rec, m)
+        kind = proof_kind(ev)
+        counts[kind] = counts.get(kind, 0) + 1
+        v = rec.get(m)
+        vs = f"{v:>14.6g}" if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) else f"{str(v):>14}"
+        print(f"{m:<{w}}{vs}  {ev or 'UNCLASSIFIED':<15}{kind}")
+        if not is_valid(ev):
+            rc = 1
+    # an evidence_classes override naming an unknown class is a bug in
+    # the producer, not a missing stamp — flag it too
+    for m, ev in (rec.get("evidence_classes") or {}).items():
+        if not is_valid(ev):
+            print(f"EVIDENCE: override {m}={ev!r} is not a known class",
+                  file=sys.stderr)
+            rc = 1
+    summary = ", ".join(f"{n} {k}" for k, n in sorted(counts.items()))
+    print(f"claims: {summary or 'none'}")
+    if args.history:
+        rows = read_bench_history(args.history)
+        unclassified = sum(1 for r in rows if record_evidence(r) is None)
+        print(f"{args.history}: {len(rows)} rows, "
+              f"{unclassified} unclassified")
+        if unclassified:
+            print(f"EVIDENCE: {unclassified} history rows carry no "
+                  f"resolvable evidence class — run "
+                  f"tools/backfill_bench_history.py", file=sys.stderr)
+            rc = 1
+    if rc:
+        print("EVIDENCE: unclassified claims present", file=sys.stderr)
+    return rc
 
 
 def _cmd_quality(args) -> int:
@@ -529,6 +694,19 @@ def _cmd_serve(args) -> int:
         print(f"\nbench trend (last {args.last_k} comparable of "
               f"{len(hist)} runs):")
         print(format_bench_trend(trend))
+        # surface what the evidence filter dropped: silence here is how
+        # CPU-fallback rows used to pass as TPU trend
+        from sagecal_tpu.obs.evidence import comparable, record_evidence
+
+        ev_new = record_evidence(hist[-1])
+        fp = hist[-1].get("config_fingerprint")
+        excluded = sum(1 for r in hist
+                       if r.get("config_fingerprint") == fp
+                       and not comparable(record_evidence(r), ev_new))
+        if excluded:
+            print(f"(evidence filter: {excluded} same-config rows "
+                  f"excluded — evidence class differs from newest "
+                  f"[{ev_new}])")
 
     if args.report:
         doc = {
@@ -661,8 +839,44 @@ def build_parser() -> argparse.ArgumentParser:
                     help="gate an extra metric (repeatable), e.g. "
                          "analytic_tflops_per_sec=0.15")
     gp.add_argument("--strict", action="store_true",
-                    help="compare even across a platform mismatch")
+                    help="compare even across an evidence-class mismatch")
     gp.set_defaults(fn=_cmd_gate)
+
+    rp = sub.add_parser(
+        "roofline",
+        help="per-kernel-family roofline attribution from a device-"
+             "profile trace (devprof capture)",
+    )
+    rp.add_argument("trace",
+                    help="a *.trace.json[.gz] file, or a capture dir "
+                         "(newest trace under it is used)")
+    rp.add_argument("--events", default=None,
+                    help="JSONL event log whose jit_compile events "
+                         "supply the flops/bytes ledger for MFU/BW-util")
+    rp.add_argument("--device-kind", default=None,
+                    help="override the device kind (default: the live "
+                         "jax.devices()[0].device_kind)")
+    rp.add_argument("--dtype", default="bf16",
+                    help="peak-table dtype column (default bf16)")
+    rp.add_argument("--gap-threshold-us", type=float, default=1000.0,
+                    help="host gap (us) splitting device busy windows "
+                         "for the dispatch analysis (default 1000)")
+    rp.add_argument("--json", action="store_true",
+                    help="print the full report as JSON")
+    rp.set_defaults(fn=_cmd_roofline)
+
+    evp = sub.add_parser(
+        "evidence",
+        help="evidence-class ledger: which banked claims are wall-"
+             "clock-proven vs AOT-proven (exit 1 on any unclassified)",
+    )
+    evp.add_argument("record", nargs="?", default="BENCH_BASELINE.json",
+                     help="bench record / baseline JSON (default "
+                          "BENCH_BASELINE.json)")
+    evp.add_argument("--history", default=None,
+                     help="also audit this BENCH_HISTORY.jsonl for "
+                          "unclassified rows")
+    evp.set_defaults(fn=_cmd_evidence)
 
     sp = sub.add_parser(
         "serve",

@@ -153,6 +153,25 @@ def _device_state() -> dict:
     return out
 
 
+def _device_profile_trace() -> Optional[str]:
+    """Newest device-profile trace when capture was armed — guarded
+    like :func:`_device_state`: only consulted when devprof is ALREADY
+    imported, so the crash path never imports anything new."""
+    devprof = sys.modules.get("sagecal_tpu.obs.devprof")
+    if devprof is None:
+        return None
+    try:
+        path = devprof.last_trace_path()
+        if path:
+            return path
+        root = os.environ.get("SAGECAL_DEVICE_PROFILE")
+        if root and os.path.isdir(root):
+            return devprof.newest_trace_path(root)
+    except Exception:
+        pass
+    return None
+
+
 class FlightRecorder:
     """Bounded activity ring + heartbeat file + hang watchdog."""
 
@@ -305,6 +324,7 @@ class FlightRecorder:
             "ring": self.snapshot(),
             "device_state": _device_state(),
             "last_checkpoint": _LAST_CHECKPOINT,
+            "device_profile_trace": _device_profile_trace(),
         }
         if exc_info is not None:
             tp, val, tb = exc_info
@@ -541,6 +561,10 @@ def format_dump(doc: dict, ring_tail: int = 20) -> str:
     lines.append(
         f"last checkpoint: {ckpt} (restart with --resume)" if ckpt
         else "last checkpoint: none (run had no checkpointing enabled)")
+    dp = doc.get("device_profile_trace")
+    if dp:
+        lines.append(f"device-profile trace: {dp} "
+                     f"(feed to `diag roofline`)")
     dev = doc.get("device_state") or {}
     if dev.get("jax_imported"):
         lines.append(

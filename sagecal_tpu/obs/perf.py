@@ -889,7 +889,7 @@ def format_perf_report(agg: dict) -> str:
 GATE_HIGHER_BETTER = (
     "value", "vs_baseline", "vs_reference_cpu",
     "analytic_tflops_per_sec", "analytic_hbm_gb_per_sec",
-    "mfu_vs_v5e_bf16_peak", "bw_util_vs_v5e_819gbps",
+    "mfu_vs_device_peak", "bw_util_vs_device_peak",
     "warm_start_speedup", "coh_bf16_iters_per_sec",
     "solves_per_sec_per_chip", "serve_batch_speedup",
     "admm_collective_bytes_reduction", "refine_outer_iters_per_sec",
@@ -971,6 +971,8 @@ def format_gate_report(rows, failures) -> str:
                          f"{tol:>6.0%}  {status}")
     else:
         lines.append("no comparable metrics between the two records")
+        lines.append("GATE: FAIL (nothing comparable)")
+        return "\n".join(lines)
     lines.append("GATE: " + ("FAIL" if failures else "PASS"))
     return "\n".join(lines)
 
@@ -981,7 +983,11 @@ def format_gate_report(rows, failures) -> str:
 # BENCH_BASELINE.json diff cannot hold.  Schema-versioned JSONL next to
 # the repo root (or SAGECAL_BENCH_HISTORY); `diag serve` renders trend
 # deltas over the last K rows against the gate direction tables above.
-BENCH_HISTORY_SCHEMA_VERSION = 1
+# v2 (PR 16): rows additionally stamp `evidence` (evidence class of the
+# record, see obs/evidence.py) and carry `device_kind`; v1 rows are
+# upgraded in place by tools/backfill_bench_history.py and both schemas
+# stay readable forever.
+BENCH_HISTORY_SCHEMA_VERSION = 2
 DEFAULT_BENCH_HISTORY = "BENCH_HISTORY.jsonl"
 
 
@@ -1015,6 +1021,8 @@ def append_bench_history(rec: dict, path: Optional[str] = None) -> str:
     path = bench_history_path(path)
     cfg_keys = ("mode", "shape", "iters", "batch", "dtype", "backend",
                 "kernel", "device_kind", "platform")
+    from sagecal_tpu.obs.evidence import record_evidence
+
     row = {
         "history_schema_version": BENCH_HISTORY_SCHEMA_VERSION,
         "ts": time.time(),
@@ -1022,6 +1030,12 @@ def append_bench_history(rec: dict, path: Optional[str] = None) -> str:
         "config_fingerprint": config_fingerprint(
             **{k: rec.get(k) for k in cfg_keys if k in rec})[:16],
     }
+    # schema v2: stamp the evidence class at measurement time (explicit
+    # field wins, else derived from platform); rows where neither
+    # resolves stay unstamped rather than guessed
+    ev = record_evidence(rec)
+    if ev is not None:
+        row["evidence"] = ev
     for k, v in rec.items():
         row.setdefault(k, v)
     d = os.path.dirname(os.path.abspath(path))
@@ -1061,12 +1075,21 @@ def bench_trend(history: List[dict], last_k: int = 5,
     metric present in the newest row, the oldest-in-window -> newest
     ratio plus a direction verdict from the gate tables (``better`` /
     ``worse`` / ``flat`` / ``info``)."""
+    from sagecal_tpu.obs.evidence import comparable, record_evidence
+
     if not history:
         return []
     newest = history[-1]
     fp = newest.get("config_fingerprint")
+    # evidence refusal (PR 16): rows whose evidence class RESOLVES and
+    # mismatches the newest row's are not trend-comparable (a CPU
+    # fallback run must never trend against TPU rows); rows where
+    # neither `evidence` nor `platform` resolves (pre-v2 / synthetic)
+    # stay comparable, so legacy history keeps working
+    ev_new = record_evidence(newest)
     window = [r for r in history
-              if r.get("config_fingerprint") == fp][-max(last_k, 2):]
+              if r.get("config_fingerprint") == fp
+              and comparable(record_evidence(r), ev_new)][-max(last_k, 2):]
     if len(window) < 2:
         return []
     oldest = window[0]

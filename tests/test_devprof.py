@@ -1,0 +1,401 @@
+"""Hardware-truth observability (PR 16): devprof + roofline + evidence.
+
+Covers the ISSUE-16 test satellite:
+
+- roofline math against analytic oracle kernels (known flops/bytes/
+  duration -> exact MFU / BW-util / intensity);
+- trace-parser round-trip on the checked-in synthetic trace fixture;
+- per-kernel attribution summing to the measured total device time;
+- gate / trend refusal on evidence-class mismatch (the CLI-level
+  refusal lives in test_perf_obs.TestGate);
+- a profiler capture+parse smoke on the CPU backend;
+- the fleet arm-file lifecycle and the flight-dump trace pointer.
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from sagecal_tpu.obs import devprof, evidence, roofline
+
+pytestmark = pytest.mark.devprof
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "devprof",
+                       "synthetic.trace.json")
+
+
+# ---------------------------------------------------------------------------
+# roofline math vs analytic oracles
+# ---------------------------------------------------------------------------
+
+
+class TestRoofline:
+    def test_peak_lookup_exact_and_alias(self):
+        assert roofline.lookup_peaks("TPU v5e")["label"] == "TPU v5e"
+        assert roofline.lookup_peaks("tpu v5 lite")["label"] == "TPU v5e"
+        assert roofline.lookup_peaks("TPU v5e (chips=1)") is not None
+        assert roofline.lookup_peaks("cpu")["nominal"] is True
+
+    def test_unknown_kind_is_none_not_wrong(self):
+        # an unknown accelerator must yield None (report says "add a
+        # PEAK_TABLE entry"), never a silently-wrong v5e number
+        assert roofline.lookup_peaks("quantum abacus") is None
+        assert roofline.mfu(1e12, "quantum abacus") is None
+        assert roofline.bw_util(1e9, "quantum abacus") is None
+
+    def test_mfu_oracle(self):
+        # 1.97 TFLOP/s on a 197 TFLOP/s part = exactly 1% MFU
+        assert roofline.mfu(1.97e12, "TPU v5e", "bf16") == pytest.approx(
+            0.01)
+        # f32 column is ~half the bf16 rate
+        assert roofline.mfu(1.97e12, "TPU v5e", "f32") == pytest.approx(
+            0.02)
+
+    def test_bw_util_oracle(self):
+        # 81.9 GB/s on an 819 GB/s HBM = exactly 10%
+        assert roofline.bw_util(81.9e9, "TPU v5e") == pytest.approx(0.1)
+
+    def test_intensity_and_ridge(self):
+        peaks = roofline.lookup_peaks("TPU v5e")
+        # ridge = peak_flops / peak_bw: 197e12 / 819e9
+        assert roofline.ridge_intensity(peaks, "bf16") == pytest.approx(
+            197e12 / 819e9)
+        lo = roofline.classify_intensity(1e6, 1e6, peaks, "bf16")
+        assert lo["intensity"] == 1.0 and lo["bound"] == "memory-bound"
+        hi = roofline.classify_intensity(1e9, 1e3, peaks, "bf16")
+        assert hi["bound"] == "compute-bound"
+        unknown = roofline.classify_intensity(None, 1e6, peaks)
+        assert unknown["bound"] == "unknown"
+
+
+# ---------------------------------------------------------------------------
+# kernel-family classifier
+# ---------------------------------------------------------------------------
+
+
+class TestClassifier:
+    def test_ledger_names_map_to_families(self):
+        cases = {
+            "jit_fused_cost_packed_chunked": "fused_grid",
+            "jit_bench_step_fused": "fused_grid",
+            "jit_sagefit_packed_batch": "batched_grid",
+            "jit_lbfgs_minibatch_batch": "batched_grid",
+            "jit_coherency_block": "xla_predict",
+            "jit_lbfgs_fit": "lbfgs_vector",
+            "jit_bench_step_xla": "lbfgs_vector",
+            "jit_mystery_thing": "other",
+        }
+        for mod, fam in cases.items():
+            assert devprof.classify_kernel(mod) == fam, mod
+
+    def test_batch_beats_fused_precedence(self):
+        # "fused_cost_packed_batch" contains both patterns: the batched
+        # grid owns it (batch rules run first by design)
+        assert devprof.classify_kernel(
+            "jit_fused_cost_packed_batch") == "batched_grid"
+
+    def test_dma_op_rule_wins_over_module(self):
+        assert devprof.classify_kernel(
+            "jit_fused_cost_packed_chunked", "copy-start.1") == "dma_infeed"
+        assert devprof.classify_kernel(
+            "jit_lbfgs_fit", "infeed.2") == "dma_infeed"
+
+
+# ---------------------------------------------------------------------------
+# trace parser + attribution on the synthetic fixture
+# ---------------------------------------------------------------------------
+
+
+class TestFixtureAttribution:
+    def test_parser_round_trip(self):
+        events, tracks = devprof.read_trace_events(FIXTURE)
+        assert tracks["1/1"] == "/host:CPU/tf_XLATfrtCpuClient/1"
+        ops = devprof.device_op_events(events, tracks)
+        # the PjitFunction runtime event (no hlo_op) is NOT a device op
+        assert len(ops) == 7
+        assert all("hlo_op" in (e.get("args") or {}) for e in ops)
+
+    def test_gzip_and_plain_parse_identically(self, tmp_path):
+        gz = tmp_path / "synthetic.trace.json.gz"
+        with open(FIXTURE, "rb") as f:
+            gz.write_bytes(gzip.compress(f.read()))
+        a = devprof.attribute_trace(FIXTURE)
+        b = devprof.attribute_trace(str(gz))
+        assert a["families"] == b["families"]
+        assert a["total_device_us"] == b["total_device_us"]
+
+    def test_family_times_sum_to_total(self):
+        att = devprof.attribute_trace(FIXTURE)
+        fam_sum = sum(f["time_us"] for f in att["families"].values())
+        # no same-track overlap in the fixture: attribution == union
+        assert fam_sum == pytest.approx(att["total_device_us"])
+        assert att["total_device_us"] == pytest.approx(450.0)
+        assert att["families"]["fused_grid"]["time_us"] == pytest.approx(
+            300.0)
+        assert att["families"]["lbfgs_vector"]["time_us"] == pytest.approx(
+            80.0)
+        assert att["families"]["dma_infeed"]["time_us"] == pytest.approx(
+            30.0)
+        assert att["families"]["xla_predict"]["time_us"] == pytest.approx(
+            40.0)
+
+    def test_trace_local_execution_count(self):
+        # fusion.1 appears twice in jit_fused_cost_packed_chunked: two
+        # executions inside the window, recovered without trusting any
+        # process-lifetime dispatch counter
+        att = devprof.attribute_trace(FIXTURE)
+        assert att["modules"]["jit_fused_cost_packed_chunked"][
+            "n_exec"] == 2
+        assert att["modules"]["jit_lbfgs_fit"]["n_exec"] == 1
+
+    def test_nested_events_billed_once(self, tmp_path):
+        # the CPU thunk runtime nests loop/fusion bodies inside their
+        # container's X event on the same track; attribution must bill
+        # self time only, or coverage overshoots 100%
+        doc = {"traceEvents": [
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 100,
+             "name": "while.1",
+             "args": {"hlo_module": "jit_lbfgs_fit", "hlo_op": "while.1"}},
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 10, "dur": 30,
+             "name": "fusion.2",
+             "args": {"hlo_module": "jit_lbfgs_fit", "hlo_op": "fusion.2"}},
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 50, "dur": 20,
+             "name": "copy.3",
+             "args": {"hlo_module": "jit_lbfgs_fit", "hlo_op": "copy.3"}},
+        ]}
+        p = tmp_path / "nested.trace.json"
+        p.write_text(json.dumps(doc))
+        att = devprof.attribute_trace(str(p))
+        assert att["total_device_us"] == pytest.approx(100.0)
+        fam_sum = sum(f["time_us"] for f in att["families"].values())
+        assert fam_sum == pytest.approx(100.0)  # not 150: children once
+        # the container keeps only its self time (100 - 30 - 20)
+        assert att["families"]["lbfgs_vector"]["time_us"] == pytest.approx(
+            80.0)
+        assert att["families"]["dma_infeed"]["time_us"] == pytest.approx(
+            20.0)
+
+    def test_dispatch_gap_analysis(self):
+        att = devprof.attribute_trace(FIXTURE, gap_threshold_us=500.0)
+        d = att["dispatch"]
+        # busy windows [1000,1150] + [2000,2280]: one 850 us host gap
+        assert d["n_windows"] == 2 and d["n_gaps"] == 1
+        assert d["gap_total_us"] == pytest.approx(850.0)
+        assert d["gap_max_us"] == pytest.approx(850.0)
+        busy = 150.0 + 280.0
+        assert d["amortization"] == pytest.approx(busy / (busy + 850.0),
+                                                 rel=1e-3)
+
+    def test_report_joins_ledger_exactly(self):
+        att = devprof.attribute_trace(FIXTURE)
+        ledger = {"jit_fused_cost_packed_chunked":
+                  {"flops": 2e6, "bytes_accessed": 1e6}}
+        rep = roofline.build_report(att, ledger, "cpu", dtype="f32")
+        assert rep["coverage"] >= 0.95
+        fused = next(r for r in rep["rows"] if r["family"] == "fused_grid")
+        # 2 executions x 2e6 flops over 300 us against the 1e10 FLOP/s
+        # nominal CPU peak
+        assert fused["flops"] == pytest.approx(4e6)
+        assert fused["mfu"] == pytest.approx(4e6 / 300e-6 / 1e10)
+        assert fused["bw_util"] == pytest.approx(2e6 / 300e-6 / 10e9)
+        assert fused["intensity"] == pytest.approx(2.0)
+        assert fused["bound"] == "compute-bound"  # CPU ridge = 1.0
+        # ranked by device time: fused_grid first
+        assert rep["rows"][0]["family"] == "fused_grid"
+        text = roofline.format_report(rep)
+        assert "fused_grid" in text and "NOMINAL" in text
+
+    def test_diag_roofline_cli(self, tmp_path, capsys):
+        from sagecal_tpu.obs import diag
+
+        elog = tmp_path / "events.jsonl"
+        elog.write_text(json.dumps(
+            {"event": "jit_compile", "fn": "fused_cost_packed_chunked",
+             "flops": 2e6, "bytes_accessed": 1e6}) + "\n")
+        rc = diag.main(["roofline", FIXTURE, "--events", str(elog),
+                        "--device-kind", "cpu", "--dtype", "f32"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "fused_grid" in out and "dispatch gaps" in out
+        # empty-trace refusal
+        empty = tmp_path / "empty.trace.json"
+        empty.write_text(json.dumps({"traceEvents": []}))
+        assert diag.main(["roofline", str(empty)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# evidence classes: stamping, comparability, trend/gate refusal
+# ---------------------------------------------------------------------------
+
+
+class TestEvidence:
+    def test_classes_and_proof_kinds(self):
+        assert evidence.proof_kind("tpu-wallclock") == "wall-clock-proven"
+        assert evidence.proof_kind("aot-bytes") == "AOT-proven"
+        assert evidence.proof_kind(None) == "unclassified"
+
+    def test_record_and_metric_resolution(self):
+        rec = {"platform": "tpu",
+               "evidence_classes": {"hier_predict_speedup": "aot-bytes"}}
+        assert evidence.record_evidence(rec) == "tpu-wallclock"
+        assert evidence.metric_evidence(rec, "value") == "tpu-wallclock"
+        assert evidence.metric_evidence(
+            rec, "hier_predict_speedup") == "aot-bytes"
+
+    def test_unresolvable_stays_comparable(self):
+        # pre-v2 / synthetic rows carry neither evidence nor platform:
+        # they must stay comparable or legacy history bricks
+        assert evidence.comparable(None, "tpu-wallclock")
+        assert evidence.comparable(None, None)
+        assert not evidence.comparable("cpu-wallclock", "tpu-wallclock")
+
+    def test_bench_map_covers_known_satellites(self):
+        m = evidence.bench_evidence_classes("tpu")
+        assert m["value"] == "tpu-wallclock"
+        assert m["hier_predict_speedup"] == "aot-bytes"
+        assert m["admm_collective_bytes_per_round"] == "aot-hlo"
+        assert m["refine_flux_err"] == "cpu-wallclock"
+        assert all(evidence.is_valid(v) for v in m.values())
+
+    def test_history_append_stamps_evidence(self, tmp_path):
+        from sagecal_tpu.obs.perf import (
+            BENCH_HISTORY_SCHEMA_VERSION,
+            append_bench_history,
+            read_bench_history,
+        )
+
+        p = tmp_path / "hist.jsonl"
+        append_bench_history({"mode": "x", "value": 1.0,
+                              "platform": "cpu"}, path=str(p))
+        (row,) = read_bench_history(str(p))
+        assert row["history_schema_version"] == BENCH_HISTORY_SCHEMA_VERSION
+        assert row["evidence"] == "cpu-wallclock"
+
+    def test_bench_trend_refuses_cross_evidence(self, tmp_path):
+        from sagecal_tpu.obs.perf import append_bench_history, bench_trend
+
+        p = tmp_path / "hist.jsonl"
+        # same config fingerprint fields, different evidence: the TPU
+        # row must not participate in the CPU row's trend window
+        for plat, v in (("cpu", 10.0), ("cpu", 11.0), ("cpu", 12.0)):
+            append_bench_history({"value": v, "platform": plat},
+                                 path=str(p))
+        from sagecal_tpu.obs.perf import read_bench_history
+
+        rows = read_bench_history(str(p))
+        trend = bench_trend(rows)
+        assert trend and trend[0]["runs"] == 3
+        # flip the middle row's evidence to tpu: window shrinks to 2
+        rows[1]["evidence"] = "tpu-wallclock"
+        trend = bench_trend(rows)
+        assert trend and trend[0]["runs"] == 2
+
+    def test_backfill_tool_round_trip(self, tmp_path):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "backfill_bench_history",
+            os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                         "backfill_bench_history.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        v1 = {"history_schema_version": 1, "value": 1.0,
+              "platform": "cpu"}
+        line, changed, classified = mod.backfill_line(
+            json.dumps(v1) + "\n")
+        assert changed and classified
+        row = json.loads(line)
+        assert row["evidence"] == "cpu-wallclock"
+        assert row["device_kind"] == "cpu"
+        assert row["evidence_backfilled"] is True
+        # idempotent: a second pass leaves the upgraded row alone
+        line2, changed2, _ = mod.backfill_line(line)
+        assert not changed2 and line2 == line
+
+    def test_diag_evidence_flags_unclassified(self, tmp_path, capsys):
+        from sagecal_tpu.obs import diag
+
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps({"value": 1.0, "platform": "cpu"}))
+        assert diag.main(["evidence", str(good)]) == 0
+        assert "wall-clock-proven" in capsys.readouterr().out
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"value": 1.0}))  # nothing resolves
+        assert diag.main(["evidence", str(bad)]) == 1
+        assert "UNCLASSIFIED" in capsys.readouterr().out
+
+    def test_repo_baseline_fully_classified(self, capsys):
+        from sagecal_tpu.obs import diag
+
+        base = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "BENCH_BASELINE.json")
+        assert diag.main(["evidence", base]) == 0
+        out = capsys.readouterr().out
+        assert "UNCLASSIFIED" not in out
+        assert "AOT-proven" in out and "wall-clock-proven" in out
+
+
+# ---------------------------------------------------------------------------
+# capture plumbing: CPU-backend smoke, fleet arming, flight pointer
+# ---------------------------------------------------------------------------
+
+
+class TestCapture:
+    def test_cpu_capture_parse_smoke(self, tmp_path):
+        # the end-to-end acceptance path: profile a jitted step on the
+        # CPU backend, parse our own emitted trace, attribute >= 95%
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def lbfgs_fit(x, y):
+            return jnp.sin(x @ y).sum()
+
+        x = jnp.ones((64, 64))
+        lbfgs_fit(x, x).block_until_ready()  # compile outside capture
+        with devprof.device_profile(str(tmp_path / "prof")) as d:
+            assert d is not None
+            for _ in range(3):
+                lbfgs_fit(x, x).block_until_ready()
+        path = devprof.last_trace_path()
+        assert path and os.path.exists(path)
+        att = devprof.attribute_trace(path)
+        assert att["n_op_events"] > 0
+        fam_sum = sum(f["time_us"] for f in att["families"].values())
+        assert fam_sum >= 0.95 * att["total_device_us"]
+        assert att["modules"]["jit_lbfgs_fit"]["n_exec"] >= 3
+
+    def test_capture_noop_without_request(self, monkeypatch):
+        monkeypatch.delenv("SAGECAL_DEVICE_PROFILE", raising=False)
+        with devprof.device_profile() as d:
+            assert d is None
+
+    def test_fleet_arm_lifecycle(self, tmp_path):
+        out = str(tmp_path / "fleet-out")
+        assert devprof.check_fleet_arm(out, "w0") is None
+        devprof.arm_fleet_profile(out, "w0")
+        # only the targeted worker sees the arm
+        assert devprof.check_fleet_arm(out, "w1") is None
+        req = devprof.check_fleet_arm(out, "w0")
+        assert req is not None
+        assert req["profile_dir"].endswith("devprof_w0")
+        done = devprof.complete_fleet_arm(req, "/tmp/x.trace.json.gz")
+        assert os.path.exists(done)
+        with open(done) as f:
+            assert json.load(f)["trace_path"] == "/tmp/x.trace.json.gz"
+        # retired: the worker never re-profiles
+        assert devprof.check_fleet_arm(out, "w0") is None
+
+    def test_flight_dump_carries_trace_path(self, monkeypatch):
+        from sagecal_tpu.obs import flight
+
+        monkeypatch.setattr(devprof, "_last_trace",
+                            "/tmp/t.trace.json.gz")
+        assert flight._device_profile_trace() == "/tmp/t.trace.json.gz"
+        text = flight.format_dump(
+            {"reason": "test",
+             "device_profile_trace": "/tmp/t.trace.json.gz"})
+        assert "diag roofline" in text

@@ -378,17 +378,52 @@ class TestGate:
         out = capsys.readouterr().out
         assert "GATE: FAIL" in out
 
-    def test_diag_gate_platform_mismatch_skips(self, tmp_path, capsys):
+    def test_diag_gate_evidence_mismatch_refuses(self, tmp_path, capsys):
+        # a cpu-wallclock run vs tpu-wallclock pins: the old behaviour
+        # was a silent SKIP (exit 0) — now the gate REFUSES loudly
+        # (exit 2) so CI can't mistake "wrong hardware" for "passed"
         b = tmp_path / "base.json"
         n = tmp_path / "new.json"
         b.write_text(json.dumps(BASE))
         n.write_text(json.dumps(dict(BASE, platform="cpu",
                                      value=BASE["value"] * 0.5)))
-        assert diag.main(["gate", str(n), "--baseline", str(b)]) == 0
-        assert "SKIP" in capsys.readouterr().out
+        assert diag.main(["gate", str(n), "--baseline", str(b)]) == 2
+        err = capsys.readouterr().err
+        assert "REFUSED" in err and "evidence-class mismatch" in err
+        assert "cpu-wallclock" in err and "tpu-wallclock" in err
         # --strict forces the comparison and catches the regression
         assert diag.main(["gate", str(n), "--baseline", str(b),
                           "--strict"]) == 1
+
+    def test_diag_gate_explicit_evidence_field_refuses(self, tmp_path,
+                                                       capsys):
+        # an explicit evidence field wins over platform derivation:
+        # same platform, different proof class -> still refused
+        b = tmp_path / "base.json"
+        n = tmp_path / "new.json"
+        b.write_text(json.dumps(dict(BASE, evidence="tpu-wallclock")))
+        n.write_text(json.dumps(dict(BASE, evidence="aot-bytes")))
+        assert diag.main(["gate", str(n), "--baseline", str(b)]) == 2
+        assert "REFUSED" in capsys.readouterr().err
+
+    def test_diag_gate_per_metric_evidence_exclusion(self, tmp_path,
+                                                     capsys):
+        # matching record-level classes, but one metric's override
+        # mismatches: that metric is dropped (with a note) and its
+        # regression does NOT fail the gate; everything else still gates
+        b = tmp_path / "base.json"
+        n = tmp_path / "new.json"
+        b.write_text(json.dumps(dict(
+            BASE, evidence_classes={"peak_device_memory_bytes":
+                                    "aot-bytes"})))
+        n.write_text(json.dumps(dict(
+            BASE, peak_device_memory_bytes=100 * 2.0e9,
+            evidence_classes={"peak_device_memory_bytes":
+                              "tpu-wallclock"})))
+        assert diag.main(["gate", str(n), "--baseline", str(b)]) == 0
+        out = capsys.readouterr().out
+        assert "peak_device_memory_bytes excluded" in out
+        assert "GATE: PASS" in out
 
     def test_pinned_repo_baseline_gates_itself(self, capsys):
         base = os.path.join(os.path.dirname(__file__), os.pardir,

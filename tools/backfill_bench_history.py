@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""One-shot BENCH_HISTORY.jsonl schema-v2 backfill.
+
+Schema v2 (PR 16) stamps an ``evidence`` class + ``device_kind`` on
+every row at measurement time; this tool upgrades the v1 rows already
+banked so the evidence filter in ``bench_trend`` and the ``diag
+evidence --history`` audit see a fully classified file.  Per row:
+
+- ``evidence`` — classified from the row's own fields
+  (:func:`sagecal_tpu.obs.evidence.classify_history_row`: explicit
+  field wins, else the wall-clock class of ``platform``, else
+  ``backend``/``mode`` hints).  Rows that resolve nothing are LEFT
+  UNCLASSIFIED and reported — a guess here would defeat the whole
+  ledger.
+- ``device_kind`` — ``"cpu"`` for cpu-platform rows (the CPU backend's
+  kind string); TPU rows without a banked kind stay unstamped (v1
+  never recorded which TPU, and inventing "v5e" would be evidence
+  laundering).
+- ``evidence_backfilled: true`` — marks the stamp as retroactive, so a
+  reader can always tell a measurement-time class from a backfilled
+  one.
+
+Already-v2 rows (and unparseable lines) pass through byte-identical.
+The rewrite is atomic (tmp + ``os.replace``); ``--dry-run`` prints the
+would-be changes without writing.  Idempotent: a second run is a
+no-op.
+
+Usage::
+
+    python tools/backfill_bench_history.py [BENCH_HISTORY.jsonl]
+    python tools/backfill_bench_history.py --dry-run
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sagecal_tpu.obs.evidence import classify_history_row  # noqa: E402
+from sagecal_tpu.obs.perf import (  # noqa: E402
+    BENCH_HISTORY_SCHEMA_VERSION,
+    bench_history_path,
+)
+
+
+def backfill_line(line: str):
+    """(new_line, changed, classified) for one history line; corrupt
+    lines and v2+ rows pass through untouched."""
+    stripped = line.strip()
+    if not stripped:
+        return line, False, True
+    try:
+        row = json.loads(stripped)
+    except json.JSONDecodeError:
+        return line, False, True
+    if not isinstance(row, dict):
+        return line, False, True
+    if int(row.get("history_schema_version", 1)) >= \
+            BENCH_HISTORY_SCHEMA_VERSION:
+        return line, False, row.get("evidence") is not None \
+            or row.get("platform") is not None
+    ev = classify_history_row(row)
+    changed = False
+    if ev is not None and "evidence" not in row:
+        row["evidence"] = ev
+        changed = True
+    if "device_kind" not in row and row.get("platform") == "cpu":
+        row["device_kind"] = "cpu"
+        changed = True
+    if changed:
+        row["evidence_backfilled"] = True
+        row["history_schema_version"] = BENCH_HISTORY_SCHEMA_VERSION
+        return json.dumps(row, default=str) + "\n", True, ev is not None
+    return line, False, ev is not None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="backfill evidence/device_kind onto schema-v1 "
+                    "BENCH_HISTORY.jsonl rows")
+    ap.add_argument("history", nargs="?", default=None,
+                    help="history file (default: $SAGECAL_BENCH_HISTORY "
+                         "or ./BENCH_HISTORY.jsonl)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="report what would change, write nothing")
+    args = ap.parse_args(argv)
+
+    path = bench_history_path(args.history)
+    if not os.path.exists(path):
+        print(f"{path}: no history file", file=sys.stderr)
+        return 1
+    with open(path, "r", encoding="utf-8") as f:
+        lines = f.readlines()
+
+    out, n_changed, n_unclassified = [], 0, 0
+    for line in lines:
+        new_line, changed, classified = backfill_line(line)
+        out.append(new_line)
+        n_changed += changed
+        n_unclassified += not classified
+    print(f"{path}: {len(lines)} lines, {n_changed} upgraded to "
+          f"schema v{BENCH_HISTORY_SCHEMA_VERSION}, "
+          f"{n_unclassified} left unclassified")
+    if args.dry_run or not n_changed:
+        return 0
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.writelines(out)
+    os.replace(tmp, path)
+    print(f"rewrote {path} atomically")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -76,6 +76,37 @@ if [ "$rc" != 0 ]; then
   echo "BATCHED AOT BYTES GATE FAILED (batched grid lost its traffic win)"
   exit 1
 fi
+# device-profiler plumbing smoke, still CPU-only: capture a real trace
+# of a jitted step via SAGECAL_DEVICE_PROFILE, parse it with our own
+# zero-dependency reader, and require `diag roofline` to render the
+# per-kernel-family table (>=95% attribution is asserted by the pytest
+# marker below; here the wiring itself must survive end to end)
+echo "=== device-profile capture -> roofline smoke (CPU)"
+DPDIR="$MANIFEST_DIR/devprof_smoke"
+rm -rf "$DPDIR"; mkdir -p "$DPDIR"
+JAX_PLATFORMS=cpu SAGECAL_DEVICE_PROFILE="$DPDIR" timeout 240 python -c "
+import jax, jax.numpy as jnp
+from sagecal_tpu.obs.devprof import device_profile, last_trace_path
+f = jax.jit(lambda x: jnp.sin(x @ x).sum())
+x = jnp.ones((64, 64)); f(x).block_until_ready()
+with device_profile():
+    for _ in range(3):
+        f(x).block_until_ready()
+assert last_trace_path(), 'no trace emitted'
+print('devprof trace:', last_trace_path())" \
+  || { echo "DEVPROF CAPTURE SMOKE FAILED"; exit 1; }
+JAX_PLATFORMS=cpu timeout 120 python -m sagecal_tpu.obs.diag roofline \
+  "$DPDIR" | tail -6
+rc=${PIPESTATUS[0]}
+if [ "$rc" != 0 ]; then echo "DIAG ROOFLINE SMOKE FAILED rc=$rc"; exit 1; fi
+# evidence-class ledger consistency: every gate-able metric banked in
+# BENCH_BASELINE.json must carry a resolvable class (zero unclassified
+# claims) and every history row must classify — a hard stop keeps
+# cpu-wallclock numbers from ever impersonating tpu-wallclock pins
+echo "=== evidence-class ledger consistency"
+JAX_PLATFORMS=cpu timeout 120 python -m sagecal_tpu.obs.diag evidence \
+  /root/repo/BENCH_BASELINE.json --history /root/repo/BENCH_HISTORY.jsonl \
+  || { echo "EVIDENCE LEDGER CHECK FAILED (unclassified claims)"; exit 1; }
 step bisect-c 200 python kbisect.py c
 step bisect-b 200 python kbisect.py b
 step bisect-a 200 python kbisect.py a
@@ -123,10 +154,10 @@ if probe; then
 fi
 echo "=== bf16-coherency fused bench"
 if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
-echo "=== telemetry+quality+trace+serve_obs+fleet+stream+sky+protocol test pass (CPU, marker-driven)"
+echo "=== telemetry+quality+trace+serve_obs+fleet+stream+sky+protocol+devprof test pass (CPU, marker-driven)"
 JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 1200 \
   python -m pytest tests/ -q \
-  -m "telemetry or quality or trace or serve_obs or fleet or stream or sky or protocol" \
+  -m "telemetry or quality or trace or serve_obs or fleet or stream or sky or protocol or devprof" \
   -p no:cacheprovider | tail -3
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then echo "telemetry test pass FAILED rc=$rc"; exit 1; fi

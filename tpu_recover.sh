@@ -34,6 +34,18 @@ DUMP=$(ls -1t /root/repo/flight_dump*.json 2>/dev/null | head -1)
 if [ -n "${DUMP:-}" ]; then
   echo "latest flight dump: $DUMP" >> "$LOG"
 fi
+# Same for the newest device-profile trace (obs/devprof.py capture,
+# SAGECAL_DEVICE_PROFILE): bench.py attaches this path to its
+# tpu_recovery_attempted event too, so a wedge mid-capture leaves a
+# `diag roofline`-able artifact in the log.
+DP_DIR="${SAGECAL_DEVICE_PROFILE:-/root/repo/devprof}"
+if [ -d "$DP_DIR" ]; then
+  DP_TRACE=$(find "$DP_DIR" -name '*.trace.json*' -type f \
+             -printf '%T@ %p\n' 2>/dev/null | sort -rn | head -1 | cut -d' ' -f2-)
+  if [ -n "${DP_TRACE:-}" ]; then
+    echo "latest device-profile trace: $DP_TRACE" >> "$LOG"
+  fi
+fi
 export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
 echo "=== banking plain TPU bench at $(date)" >> "$LOG"
 timeout 900 python bench.py > /root/repo/bench_tpu_watch.json 2>/root/repo/bench_tpu_watch.err
