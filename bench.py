@@ -932,6 +932,86 @@ def run_fleet_bench(n_requests=6, workers=2, timeout=1200.0):
     }
 
 
+def run_load_bench(rates=(0.5, 1.5, 6.0), step_s=20.0, workers=2,
+                   timeout=1200.0):
+    """Fleet load/capacity row: a seeded stepped-ramp load run
+    (apps/load.py) against a real two-worker fleet, analysed by
+    obs/capacity.py.
+
+    Two load runs share one AOT artifact store: a short warm-up pass
+    pays every compile (both tenant buckets), then the MEASURED
+    stepped run offers ``rates`` (solves/s) for ``step_s`` each —
+    straddling the warm fleet's CPU capacity so the top step genuinely
+    overloads (tight SLO deadlines + shed admission policy).  Banked
+    gateable headlines, all cpu-wallclock evidence:
+
+    - ``saturation_throughput_solves_per_sec``: best served rate on
+      the offered-load curve (the capacity estimate);
+    - ``shed_rate_under_overload``: shed fraction of dispositions at
+      the highest offered step;
+    - ``goodput_fraction_at_saturation``: deadline-met fraction of
+      served work at the saturation step.
+    """
+    import shutil
+    import tempfile
+    import time as _time
+
+    workdir = tempfile.mkdtemp(prefix="sagecal-load-bench-")
+    try:
+        store = os.path.join(workdir, "aot-store")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SAGECAL_TELEMETRY="1")
+
+        def one(tag: str, rates_s: str, step: float, drain: float):
+            out = os.path.join(workdir, tag)
+            proc = subprocess.run(
+                [sys.executable, "-m", "sagecal_tpu.apps.cli", "load",
+                 "--out-dir", out, "--aot-store", store,
+                 "--workers", str(workers), "--rates", rates_s,
+                 "--step", str(step), "--tenants", "2", "--seed", "23",
+                 "--warmup", "12", "--drain-timeout", str(drain)],
+                env=env, timeout=timeout, capture_output=True)
+            if proc.returncode not in (0, 4):
+                raise RuntimeError(
+                    f"load bench ({tag}) exited {proc.returncode}: "
+                    f"{proc.stderr.decode()[-800:]}")
+            with open(os.path.join(out, "load_report.json")) as f:
+                return json.load(f), proc.returncode
+
+        # warm-up: low rate, one step — populates the store so the
+        # measured run sees zero compiles and the curve reflects
+        # steady-state capacity, not compile stalls
+        t0 = _time.perf_counter()
+        one("warm", "0.4", 30.0, 300.0)
+        warm_s = _time.perf_counter() - t0
+        report, rc = one("measured",
+                         ",".join(str(r) for r in rates),
+                         step_s, 300.0)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    knee = report.get("knee") or {}
+    ll = report.get("littles_law") or {}
+    return {
+        "workers": workers,
+        "rates": list(rates),
+        "step_s": step_s,
+        "warmup_wall_s": round(warm_s, 2),
+        "drained": bool(report.get("drained", rc == 0)),
+        "manifests": report.get("manifests"),
+        "served": report.get("served"),
+        "shed": report.get("shed"),
+        "saturation_throughput_solves_per_sec": round(
+            float(report["saturation_throughput_solves_per_sec"]), 4),
+        "shed_rate_under_overload": round(
+            float(report["shed_rate_under_overload"]), 4),
+        "goodput_fraction_at_saturation": round(
+            float(report["goodput_fraction_at_saturation"]), 4),
+        "knee_offered_rate": knee.get("knee_offered_rate"),
+        "littles_law_ok": bool(ll.get("live_ok"))
+        and bool(ll.get("posthoc_ok")),
+    }
+
+
 def run_widefield_bench(nsources=10000, nblobs=40, nstations=40,
                         order=8, theta=1.5, repeats=5, seed=3):
     """Wide-field hierarchical-predict row: compiled memory traffic and
@@ -1304,6 +1384,18 @@ def main(argv=None):
             except Exception as exc:  # never sink the headline bench
                 sys.stderr.write(f"bench: fleet bench failed: {exc}\n")
 
+    # fleet load/capacity row: stepped-ramp offered load vs a warm
+    # two-worker fleet (subprocess CPU workers); banks the saturation
+    # throughput, overload shed rate and goodput-at-saturation.
+    # SAGECAL_BENCH_NO_LOAD=1 skips it.
+    load_rec = None
+    if not os.environ.get("SAGECAL_BENCH_NO_LOAD"):
+        with tracer.span("bench", kind="run", variant="load"):
+            try:
+                load_rec = run_load_bench()
+            except Exception as exc:  # never sink the headline bench
+                sys.stderr.write(f"bench: load bench failed: {exc}\n")
+
     # wide-field hierarchical-predict row: compiled-traffic ratio vs the
     # exact predict at the 10k-source shape + sampled error at the
     # default (order, theta) knob.  SAGECAL_BENCH_NO_WIDEFIELD=1 skips.
@@ -1474,6 +1566,17 @@ def main(argv=None):
         rec["fleet_solves_per_sec_2workers"] = (
             fleet_rec["fleet_solves_per_sec_2workers"])
         rec["fleet_bench"] = fleet_rec
+    if load_rec is not None:
+        # gate-able load/capacity rows (obs/perf.py knows the
+        # directions): saturation throughput + goodput higher-better,
+        # overload shed rate lower-better (opt-in gate — policy-shaped)
+        rec["saturation_throughput_solves_per_sec"] = (
+            load_rec["saturation_throughput_solves_per_sec"])
+        rec["shed_rate_under_overload"] = (
+            load_rec["shed_rate_under_overload"])
+        rec["goodput_fraction_at_saturation"] = (
+            load_rec["goodput_fraction_at_saturation"])
+        rec["load_bench"] = load_rec
     if widefield_rec is not None:
         # gate-able wide-field hierarchical-predict rows (obs/perf.py
         # knows the directions): compiled-traffic ratio higher-better,

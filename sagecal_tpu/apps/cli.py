@@ -302,6 +302,13 @@ def main(argv=None):
         from sagecal_tpu.apps.fleet import main as fleet_main
 
         return fleet_main(argv[1:])
+    if argv and argv[0] == "load":
+        # synthetic-tenant load harness vs a live fleet: seeded
+        # open-loop arrivals, live timeline, capacity report
+        # (apps/load.py / fleet/loadgen.py / obs/capacity.py)
+        from sagecal_tpu.apps.load import main as load_main
+
+        return load_main(argv[1:])
     if argv and argv[0] == "stream":
         # sliding-window streaming calibration with the elastic
         # warm-start chain (apps/stream.py)

@@ -304,6 +304,25 @@ class FleetConfig:
     verbose: bool = False
     slo: str = ""
     max_streams: int = 8
+    # live observability (obs/timeline.py): the coordinator appends one
+    # timeline.jsonl row per watch poll and feeds the report-only
+    # autoscale recommender (obs/capacity.py) — pure observation unless
+    # elastic_workers is set
+    timeline: bool = True
+    # bounded respawn of CRASHED workers (nonzero exit with work left):
+    # per-slot replacement budget; clean exits never respawn
+    max_respawns: int = 2
+    # opt-in: act on the recommender (spawn/retire one worker per
+    # recommendation change, clamped to [min_workers, max_workers];
+    # retire = SIGTERM -> the worker's existing lease-release path).
+    # Off (default) the recommender provably changes no solve output.
+    elastic_workers: bool = False
+    min_workers: int = 1
+    max_workers: int = 0        # 0 = max(workers, min_workers)
+    # open-loop submission (the load harness): arrivals keep landing
+    # AFTER workers start, so "every item submitted so far is done" is
+    # not an exit signal — workers hold on until max_idle_s or SIGTERM
+    open_loop: bool = False
 
 
 @dataclasses.dataclass

@@ -97,6 +97,28 @@ def build_parser() -> argparse.ArgumentParser:
                     "admission control deadlines; falls back to a "
                     "'slos' key in the request manifest")
     ap.add_argument("-V", "--verbose", action="store_true")
+    ap.add_argument("--no-timeline", action="store_true",
+                    help="disable the coordinator's live timeline "
+                    "sampler (obs/timeline.py timeline.jsonl) and the "
+                    "report-only autoscale recommender")
+    ap.add_argument("--max-respawns", type=int, default=2,
+                    help="per-worker budget for respawning CRASHED "
+                    "workers (nonzero exit with work left); clean "
+                    "exits never respawn")
+    ap.add_argument("--elastic-workers", action="store_true",
+                    help="act on the autoscale recommender: spawn/"
+                    "retire one worker per recommendation change, "
+                    "clamped to [--min-workers, --max-workers].  "
+                    "Retire = SIGTERM -> the worker's existing "
+                    "lease-release path.  Off: report-only")
+    ap.add_argument("--min-workers", type=int, default=1)
+    ap.add_argument("--max-workers", type=int, default=0,
+                    help="elastic ceiling (0 = max(--workers, "
+                    "--min-workers))")
+    ap.add_argument("--open-loop", action="store_true",
+                    help="arrivals keep landing after workers start "
+                    "(load harness): workers ignore the all-done exit "
+                    "and hold on until --max-idle or SIGTERM")
     ap.add_argument("--profile-worker", default="", metavar="WID",
                     help="coordinator: arm worker WID for a one-cycle "
                     "device-profile capture by dropping the devprof "
@@ -128,7 +150,12 @@ def config_from_args(args) -> FleetConfig:
         solver_mode=args.solver_mode, nulow=args.nulow,
         nuhigh=args.nuhigh, randomize=not args.no_randomize,
         use_f64=not args.f32, use_fused_predict=args.fused,
-        coh_dtype=args.coh_dtype, verbose=args.verbose, slo=args.slo)
+        coh_dtype=args.coh_dtype, verbose=args.verbose, slo=args.slo,
+        timeline=not args.no_timeline,
+        max_respawns=args.max_respawns,
+        elastic_workers=args.elastic_workers,
+        min_workers=args.min_workers, max_workers=args.max_workers,
+        open_loop=args.open_loop)
 
 
 def _obs_setup(cfg, role: str):

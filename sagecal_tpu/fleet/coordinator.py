@@ -30,7 +30,7 @@ import os
 import subprocess
 import sys
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Set
 
 from sagecal_tpu.fleet.queue import LeaseQueue, WorkItem
 
@@ -106,6 +106,8 @@ def worker_argv(cfg, index: int) -> List[str]:
             "-j", str(cfg.solver_mode)]
     if cfg.slo:
         argv += ["--slo", cfg.slo]
+    if getattr(cfg, "open_loop", False):
+        argv += ["--open-loop"]
     if not cfg.use_f64:
         argv += ["--f32"]
     if cfg.verbose:
@@ -124,20 +126,177 @@ class FleetCoordinator:
             cfg.queue_dir or os.path.join(cfg.out_dir, "queue"),
             worker="coordinator", ttl_s=cfg.lease_ttl_s, clock=clock)
         self.procs: List[subprocess.Popen] = []
+        # worker-slot table: slot index -> CURRENT Popen for that
+        # SAGECAL_WORKER_ID.  A respawn replaces the slot's proc (same
+        # wid, so obs/aggregate.dedupe_snapshots supersedes the dead
+        # predecessor's snapshot); retired slots never respawn.
+        self._slots: Dict[int, subprocess.Popen] = {}
+        self._next_slot = 0
+        self._respawns: Dict[int, int] = {}
+        self._retired: Set[int] = set()
+        self._handled: Set[int] = set()  # dead pids already triaged
+        self.elog = None
+        self._sampler = None
+        self._recommender = None
+
+    # -- observability (live timeline + report-only recommender) -------
+
+    def setup_observability(self, specs=None, elog=None) -> None:
+        """Arm the live timeline sampler and the autoscale recommender
+        for this run.  Pure observation plus an advisory in-memory
+        recommendation — only ``cfg.elastic_workers`` makes
+        :meth:`poll_duties` act on it."""
+        self.elog = elog
+        if not getattr(self.cfg, "timeline", True):
+            return
+        from sagecal_tpu.obs.capacity import (
+            AutoscaleRecommender, RecommenderConfig,
+        )
+        from sagecal_tpu.obs.timeline import TimelineSampler, timeline_path
+
+        os.makedirs(self.cfg.out_dir, exist_ok=True)
+        self._sampler = TimelineSampler(
+            timeline_path(self.cfg.out_dir), queue=self.queue,
+            out_dir=self.cfg.out_dir, slo_specs=specs,
+            aot_store=self.cfg.aot_store or
+            os.path.join(self.cfg.out_dir, "aot-store"),
+            clock=self.clock)
+        lo = max(int(getattr(self.cfg, "min_workers", 1)), 1)
+        hi = int(getattr(self.cfg, "max_workers", 0)) or max(
+            self.cfg.workers, lo)
+        self._recommender = AutoscaleRecommender(
+            RecommenderConfig(min_workers=lo,
+                              max_workers=max(hi, lo)),
+            self.cfg.workers)
+
+    def close_observability(self) -> None:
+        sampler, self._sampler = self._sampler, None
+        if sampler is not None:
+            sampler.close()
+        self._recommender = None
+
+    # -- worker lifecycle ----------------------------------------------
+
+    def _spawn_slot(self, slot: int) -> subprocess.Popen:
+        env = dict(os.environ, SAGECAL_WORKER_ID=f"w{slot}")
+        # the fleet view (compile/AOT-hit accounting, snapshots) is
+        # metrics-registry-driven, and the registry is telemetry-
+        # gated — default it ON for workers; an explicit operator
+        # setting (even "0") still wins
+        env.setdefault("SAGECAL_TELEMETRY", "1")
+        p = subprocess.Popen(worker_argv(self.cfg, slot), env=env)
+        self.procs.append(p)
+        self._slots[slot] = p
+        return p
 
     def spawn_workers(self, n: Optional[int] = None) -> None:
         n = self.cfg.workers if n is None else n
-        for i in range(n):
-            env = dict(os.environ, SAGECAL_WORKER_ID=f"w{i}")
-            # the fleet view (compile/AOT-hit accounting, snapshots) is
-            # metrics-registry-driven, and the registry is telemetry-
-            # gated — default it ON for workers; an explicit operator
-            # setting (even "0") still wins
-            env.setdefault("SAGECAL_TELEMETRY", "1")
-            self.procs.append(subprocess.Popen(
-                worker_argv(self.cfg, i), env=env))
-        self.log(f"fleet: spawned {n} workers "
-                 f"(pids {[p.pid for p in self.procs]})")
+        pids = []
+        for _ in range(n):
+            slot = self._next_slot
+            self._next_slot += 1
+            pids.append(self._spawn_slot(slot).pid)
+        self.log(f"fleet: spawned {n} workers (pids {pids})")
+
+    def _respawn_crashed(self, now: float) -> None:
+        """Bounded respawn of crashed workers: a slot whose proc died
+        with a nonzero exit while work remains gets a replacement with
+        the SAME worker id, up to ``cfg.max_respawns`` times per slot —
+        a load measurement must not silently degrade to fewer workers.
+        Clean exits (idle drain) and retired slots are not crashes."""
+        cap = int(getattr(self.cfg, "max_respawns", 2))
+        for slot, p in list(self._slots.items()):
+            rc = p.poll()
+            if rc is None or p.pid in self._handled:
+                continue
+            self._handled.add(p.pid)
+            if rc == 0 or slot in self._retired:
+                continue
+            if self.queue.all_done(empty=False):
+                continue
+            count = self._respawns.get(slot, 0)
+            if count >= cap:
+                self.log(f"fleet: worker w{slot} crashed (rc={rc}) "
+                         f"with respawn budget exhausted "
+                         f"({count}/{cap})")
+                continue
+            self._respawns[slot] = count + 1
+            np_ = self._spawn_slot(slot)
+            self.log(f"fleet: respawned crashed worker w{slot} "
+                     f"(rc={rc}, attempt {count + 1}/{cap}, "
+                     f"pid {np_.pid})")
+            if self.elog is not None:
+                self.elog.emit("worker_respawned", slot=slot,
+                               worker=f"w{slot}", exit_code=rc,
+                               attempt=count + 1, max_respawns=cap,
+                               pid=np_.pid)
+
+    def _live_slots(self) -> List[int]:
+        return sorted(s for s, p in self._slots.items()
+                      if p.poll() is None and s not in self._retired)
+
+    def _apply_scale(self, target: int) -> None:
+        """Honor the in-memory recommendation (``--elastic-workers``):
+        spawn up to ``target`` live workers, or retire down to it by
+        SIGTERMing the highest slots — the worker's existing SIGTERM →
+        SystemExit path releases its leases in its finally block (the
+        stop-claiming-then-clean-exit contract), so retirement adds no
+        new coordination file to the lease protocol."""
+        lo = max(int(getattr(self.cfg, "min_workers", 1)), 1)
+        hi = int(getattr(self.cfg, "max_workers", 0)) or max(
+            self.cfg.workers, lo)
+        target = max(lo, min(int(target), max(hi, lo)))
+        live = self._live_slots()
+        if len(live) < target:
+            for _ in range(target - len(live)):
+                slot = self._next_slot
+                self._next_slot += 1
+                p = self._spawn_slot(slot)
+                self.log(f"fleet: elastic scale-up -> w{slot} "
+                         f"(pid {p.pid}, {len(self._live_slots())} "
+                         f"live)")
+                if self.elog is not None:
+                    self.elog.emit("worker_scaled_up", slot=slot,
+                                   worker=f"w{slot}", pid=p.pid,
+                                   target=target)
+        elif len(live) > target:
+            for slot in reversed(live[target:]):
+                self._retired.add(slot)
+                self._slots[slot].terminate()
+                self.log(f"fleet: elastic retire -> w{slot} "
+                         f"(SIGTERM; leases release on exit)")
+                if self.elog is not None:
+                    self.elog.emit("worker_retired", slot=slot,
+                                   worker=f"w{slot}", target=target)
+
+    def poll_duties(self, now: Optional[float] = None) -> None:
+        """The coordinator's once-per-poll housekeeping: triage dead
+        workers (bounded respawn), append one live timeline row, feed
+        the recommender, and — only under ``--elastic-workers`` —
+        act on its recommendation."""
+        now = self.clock() if now is None else float(now)
+        self._respawn_crashed(now)
+        if self._sampler is None or self._sampler.closed:
+            return
+        alive = sum(1 for p in self.procs if p.poll() is None)
+        row = self._sampler.sample(now=now, alive_workers=alive)
+        if self._recommender is None:
+            return
+        rec = self._recommender.update(row)
+        if rec is not None:
+            from sagecal_tpu.obs.capacity import write_recommendation
+
+            write_recommendation(self.cfg.out_dir, rec)
+            self.log(
+                f"fleet: scale recommendation -> "
+                f"{rec['recommended_workers']} workers "
+                f"(was {rec['previous_workers']}, {rec['reason']})")
+            if self.elog is not None:
+                self.elog.emit("scale_recommendation", **{
+                    k: v for k, v in rec.items()
+                    if k != "schema_version"})
+        if getattr(self.cfg, "elastic_workers", False):
+            self._apply_scale(self._recommender.recommended)
 
     def watch(self, timeout_s: float = 0.0,
               poll_s: float = 1.0) -> bool:
@@ -148,9 +307,11 @@ class FleetCoordinator:
         while True:
             if self.queue.all_done():
                 return True
+            self.poll_duties()
             alive = [p for p in self.procs if p.poll() is None]
             stats = self.queue.stats()
             line = (f"fleet: {stats['done']}/{stats['items']} done, "
+                    f"{stats['waiting']} waiting, "
                     f"{stats['leased']} leased, "
                     f"{stats['expired_leases']} expired leases, "
                     f"{len(alive)} workers alive")
@@ -253,12 +414,14 @@ class FleetCoordinator:
             elog.emit("fleet_seeded", n=len(requests),
                       queue=self.queue.root,
                       workers=self.cfg.workers)
+        self.setup_observability(specs=specs, elog=elog)
         try:
             self.spawn_workers()
             drained = self.watch()
             self.await_armed_profiles()
         finally:
             self.shutdown()
+            self.close_observability()
         summary = self.summary(requests)
         summary["drained"] = drained
         summary["wall_s"] = self.clock() - t0

@@ -384,13 +384,25 @@ class LeaseQueue:
                 leased += 1
             else:
                 expired += 1
-        return {"items": len(items),
-                "done": sum(1 for i in items if i.request_id in done),
-                "leased": leased, "expired_leases": expired}
+        ndone = sum(1 for i in items if i.request_id in done)
+        return {"items": len(items), "done": ndone,
+                "leased": leased, "expired_leases": expired,
+                # never-leased items still waiting to be claimed (the
+                # live-timeline waiting-room gauge)
+                "waiting": max(len(items) - ndone - leased - expired,
+                               0)}
 
-    def all_done(self) -> bool:
+    def all_done(self, empty: bool = True) -> bool:
+        """True iff every queued request has a done marker.  ``empty``
+        picks the answer for a queue with no items at all: a seeded
+        fleet treats that as drained (vacuous truth), while open-loop
+        load harnesses pass ``empty=False`` because arrivals are still
+        being submitted and an empty queue just means "no work YET"."""
+        items = self.items()
+        if not items:
+            return empty
         done = self.done_ids()
-        return all(it.request_id in done for it in self.items())
+        return all(it.request_id in done for it in items)
 
     # -- claim protocol ------------------------------------------------
 

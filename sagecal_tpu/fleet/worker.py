@@ -55,8 +55,12 @@ def _request_from_item(item: WorkItem):
     from sagecal_tpu.serve.request import SolveRequest
 
     fields = {f.name for f in dataclasses.fields(SolveRequest)}
-    return SolveRequest(**{k: v for k, v in item.request.items()
-                           if k in fields})
+    kw = {k: v for k, v in item.request.items() if k in fields}
+    if item.enqueued_at:
+        # the fleet queue is the tenant-visible queue: manifests must
+        # report wait since WorkItem enqueue, not since worker claim
+        kw["enqueued_at"] = item.enqueued_at
+    return SolveRequest(**kw)
 
 
 class FleetWorker:
@@ -455,7 +459,11 @@ class FleetWorker:
                 else:
                     self.process(claimed, elog=elog)
                 continue
-            if self.queue.all_done():
+            if (not getattr(cfg, "open_loop", False)
+                    and self.queue.all_done(empty=False)):
+                # under open-loop load the queue repeatedly LOOKS
+                # drained between arrivals; only idle timeout or the
+                # coordinator's SIGTERM ends an open-loop worker
                 break
             now = self.clock()
             if idle_since is None:

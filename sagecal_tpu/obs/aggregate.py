@@ -223,12 +223,16 @@ def read_result_manifests(*out_dirs: str) -> List[dict]:
     return out
 
 
-def queue_depth_timeline(results: Sequence[dict],
-                         max_points: int = 64) -> List[Tuple[float, int]]:
-    """Reconstruct a queue-depth (waiting requests) timeline from result
-    manifests alone: +1 at ``enqueued_at``, -1 at ``started_at``.
-    Returns ``[(t_rel_seconds, depth), ...]`` sampled at every change
-    (down-sampled to ``max_points``)."""
+def queue_depth_series(results: Sequence[dict]) -> List[Tuple[float, int]]:
+    """Reconstruct the waiting-room depth from result manifests alone:
+    +1 at ``enqueued_at``, -1 at ``started_at``, ABSOLUTE timestamps.
+    Shed manifests participate (a to-be-shed request occupied the queue
+    until its shed decision — ``started_at`` — exactly like the live
+    view counts it); they are excluded from *served-work* accounting by
+    obs/capacity.served_results, not from depth.  At equal timestamps
+    arrivals apply before departures, so a zero-wait disposition (e.g.
+    an instant shed with ``started_at == enqueued_at``) can never swing
+    the reconstructed depth negative."""
     edges: List[Tuple[float, int]] = []
     for r in results:
         enq = r.get("enqueued_at")
@@ -239,13 +243,24 @@ def queue_depth_timeline(results: Sequence[dict],
         edges.append((float(sta), -1))
     if not edges:
         return []
-    edges.sort()
-    t0 = edges[0][0]
+    edges.sort(key=lambda e: (e[0], -e[1]))
     depth = 0
     line: List[Tuple[float, int]] = []
     for t, d in edges:
         depth += d
-        line.append((t - t0, depth))
+        line.append((t, depth))
+    return line
+
+
+def queue_depth_timeline(results: Sequence[dict],
+                         max_points: int = 64) -> List[Tuple[float, int]]:
+    """:func:`queue_depth_series` rebased to run-relative seconds and
+    down-sampled to ``max_points`` (the ``diag serve`` rendering)."""
+    series = queue_depth_series(results)
+    if not series:
+        return []
+    t0 = series[0][0]
+    line = [(t - t0, depth) for t, depth in series]
     if len(line) > max_points:
         step = len(line) / float(max_points)
         line = [line[int(i * step)] for i in range(max_points)]

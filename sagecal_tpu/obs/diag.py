@@ -68,6 +68,14 @@ Subcommands:
   all-thread stacks, and the activity-ring tail.  Exit 1 when the file
   is missing or not a dump.
 
+- ``load OUT_DIR [--slo FILE] [--knee-tol T] [--report FILE]`` — the
+  load/capacity report of a ``sagecal-tpu load`` run: throughput- and
+  goodput-vs-offered-load curve per step, saturation knee, shed rate
+  under overload, queue-growth rates, the Little's-law (L = λW)
+  cross-check of the live timeline against the post-hoc manifest
+  reconstruction, and the latest autoscale recommendation.  Exit 1
+  when the timeline is missing/invalid or the cross-checks disagree.
+
 Runs standalone (``python -m sagecal_tpu.obs.diag ...``) or via the
 ``diag`` subcommand of the main CLI (:mod:`sagecal_tpu.apps.cli`).
 """
@@ -732,6 +740,61 @@ def _cmd_serve(args) -> int:
     return rc
 
 
+def _cmd_load(args) -> int:
+    """Load/capacity report of one ``sagecal-tpu load`` out-dir:
+    curve + knee + shed + Little's-law cross-check + recommendation.
+    Exit 1 on a missing/invalid timeline or a failed cross-check."""
+    from sagecal_tpu.obs.capacity import (
+        analyze_load_run, format_load_report,
+    )
+    from sagecal_tpu.obs.slo import load_slo_specs
+    from sagecal_tpu.obs.timeline import (
+        read_timeline, timeline_path, validate_timeline,
+    )
+
+    out_dir = args.out_dir
+    specs = {}
+    slo = args.slo or os.path.join(out_dir, "workload", "slo.json")
+    if os.path.exists(slo):
+        specs = load_slo_specs(slo)
+    rc = 0
+    rows = read_timeline(timeline_path(out_dir))
+    problems = validate_timeline(rows)
+    if problems:
+        print(f"timeline {timeline_path(out_dir)}: INVALID",
+              file=sys.stderr)
+        for p in problems[:10]:
+            print(f"  {p}", file=sys.stderr)
+        rc = 1
+    try:
+        report = analyze_load_run(
+            out_dir, specs, knee_tol=args.knee_tol,
+            littles_rtol=args.littles_rtol,
+            littles_atol=args.littles_atol)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"{out_dir}: {e}", file=sys.stderr)
+        return 1
+    print(format_load_report(report))
+    if not report["littles_law"]["ok"]:
+        print("LITTLES-LAW CROSS-CHECK FAILED: live timeline, "
+              "post-hoc reconstruction and λW disagree beyond "
+              "tolerance", file=sys.stderr)
+        rc = 1
+    if report["reconcile"].get("comparable") \
+            and not report["reconcile"]["ok"]:
+        print("LIVE/POST-HOC DEPTH MISMATCH: the two queue-depth "
+              "views disagree beyond tolerance", file=sys.stderr)
+        rc = 1
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True,
+                      default=float)
+            f.write("\n")
+        print(f"report -> {args.report}")
+    print("LOAD: " + ("UNHEALTHY" if rc else "OK"))
+    return rc
+
+
 def _cmd_trace(args) -> int:
     from sagecal_tpu.obs.trace import (
         format_trace_report,
@@ -907,6 +970,32 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--report", default=None,
                     help="also write a machine-readable JSON report")
     sp.set_defaults(fn=_cmd_serve)
+
+    ldp = sub.add_parser(
+        "load",
+        help="load/capacity report: throughput-vs-offered curve, "
+             "saturation knee, shed rate, Little's-law cross-check, "
+             "autoscale recommendation (exit 1 on disagreement)",
+    )
+    ldp.add_argument("out_dir",
+                     help="a `sagecal-tpu load` --out-dir (manifests "
+                          "+ timeline.jsonl + load_steps.json)")
+    ldp.add_argument("--slo", default="",
+                     help="slo.json for goodput deadlines (default "
+                          "<out_dir>/workload/slo.json)")
+    ldp.add_argument("--knee-tol", type=float, default=0.10,
+                     help="throughput this fraction below offered = "
+                          "saturated (default 0.10)")
+    ldp.add_argument("--littles-rtol", type=float, default=0.35,
+                     help="relative tolerance of the L = λW "
+                          "cross-check (default 0.35)")
+    ldp.add_argument("--littles-atol", type=float, default=1.0,
+                     help="absolute depth slack of the cross-check "
+                          "(default 1.0 items)")
+    ldp.add_argument("--report", default=None,
+                     help="also write the machine-readable JSON "
+                          "report here")
+    ldp.set_defaults(fn=_cmd_load)
 
     qp = sub.add_parser(
         "quality",

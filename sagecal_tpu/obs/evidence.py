@@ -150,6 +150,12 @@ def bench_evidence_classes(platform: Optional[str]) -> Dict[str, str]:
         "fleet_solves_per_sec_2workers": "cpu-wallclock",
         "hier_predict_max_rel_err": "cpu-wallclock",
         "admm_straggler_ratio": "cpu-wallclock",
+        # load/capacity rows: stepped-ramp load vs subprocess CPU
+        # workers (bench.run_load_bench) — honest CPU wall-clock, never
+        # a device-speed claim
+        "saturation_throughput_solves_per_sec": "cpu-wallclock",
+        "shed_rate_under_overload": "cpu-wallclock",
+        "goodput_fraction_at_saturation": "cpu-wallclock",
         # wall-clock headline + serve/coherency rows follow the run's
         # platform: bench measures them on the live device
         "value": wall,

@@ -894,7 +894,8 @@ GATE_HIGHER_BETTER = (
     "solves_per_sec_per_chip", "serve_batch_speedup",
     "admm_collective_bytes_reduction", "refine_outer_iters_per_sec",
     "stream_warm_speedup", "fleet_solves_per_sec_2workers",
-    "hier_predict_speedup",
+    "hier_predict_speedup", "saturation_throughput_solves_per_sec",
+    "goodput_fraction_at_saturation",
 )
 GATE_LOWER_BETTER = (
     "xla_cost_analysis_bytes_accessed", "peak_device_memory_bytes",
@@ -902,6 +903,10 @@ GATE_LOWER_BETTER = (
     "serve_p50_latency_s", "admm_collective_bytes_per_round",
     "admm_straggler_ratio", "refine_flux_err",
     "latency_to_first_solution_s", "hier_predict_max_rel_err",
+    # opt-in gate (--metric shed_rate_under_overload=tol): the shed
+    # rate is admission-POLICY-shaped, not pure capacity, so it is
+    # direction-tagged here but left out of GATE_DEFAULT_METRICS
+    "shed_rate_under_overload",
 )
 # the metrics gated when present in BOTH records (others opt in via
 # --metric name=tol)
@@ -914,6 +919,8 @@ GATE_DEFAULT_METRICS = (
     "refine_flux_err", "refine_outer_iters_per_sec",
     "latency_to_first_solution_s", "fleet_solves_per_sec_2workers",
     "hier_predict_speedup", "hier_predict_max_rel_err",
+    "saturation_throughput_solves_per_sec",
+    "goodput_fraction_at_saturation",
 )
 GATE_DEFAULT_TOLERANCE = 0.10
 

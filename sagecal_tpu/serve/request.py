@@ -61,6 +61,11 @@ class SolveRequest:
     # logical trace survives process boundaries and --resume; derived
     # from the request_id when the submitter doesn't pick one
     trace_id: str = ""
+    # upstream enqueue wall-clock (unix).  A fronting queue (the fleet's
+    # LeaseQueue) sets this so queue_wait_s in the result manifest spans
+    # the WHOLE wait, not just the service-internal round-robin; 0 means
+    # the service stamps its own submit time
+    enqueued_at: float = 0.0
     # None = inherit the ServeConfig default
     solver_mode: Optional[int] = None
     max_emiter: Optional[int] = None

@@ -609,8 +609,9 @@ class CalibrationService:
             t: collections.deque(
                 r for r, f in zip(by_tenant[t], done_flags[t]) if not f)
             for t in tenants}
-        enqueued_at = {r.request_id: time.time()
-                       for t in tenants for r in queues[t]}
+        enqueued_at = {
+            r.request_id: getattr(r, "enqueued_at", 0.0) or time.time()
+            for t in tenants for r in queues[t]}
         for t in tenants:
             reg.gauge_set("serve_queue_depth", len(queues[t]),
                           tenant=t,

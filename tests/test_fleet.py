@@ -114,7 +114,7 @@ class TestLeaseQueue:
         q.complete("r2", verdict="ok")
         st = q.stats(now=1005.0)
         assert st == {"items": 3, "done": 1, "leased": 1,
-                      "expired_leases": 0}
+                      "expired_leases": 0, "waiting": 1}
         # r1's lease expires: it becomes pending again
         st = q.stats(now=1011.0)
         assert st["expired_leases"] == 1

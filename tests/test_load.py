@@ -1,0 +1,479 @@
+"""Fleet load & capacity observability (fleet/loadgen.py,
+obs/timeline.py, obs/capacity.py): seeded-schedule determinism, knee
+detection vs analytic oracles, Little's-law and live-vs-posthoc
+reconciliation fixtures, recommender hysteresis, timeline validation,
+and the slow stepped-load e2e against a real two-worker fleet."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.load
+
+
+# ---------------------------------------------------------------------------
+# seeded arrival schedules
+
+
+class TestScheduleDeterminism:
+    def test_same_seed_byte_identical(self):
+        from sagecal_tpu.fleet.loadgen import LoadSpec, schedule_json
+
+        for arrival in ("poisson", "onoff", "ramp"):
+            spec = LoadSpec(arrival=arrival, seed=7, tenants=3)
+            assert schedule_json(spec) == schedule_json(spec), arrival
+
+    def test_seed_changes_schedule(self):
+        from sagecal_tpu.fleet.loadgen import LoadSpec, schedule_json
+
+        a = schedule_json(LoadSpec(arrival="poisson", seed=7))
+        b = schedule_json(LoadSpec(arrival="poisson", seed=8))
+        assert a != b
+
+    def test_ramp_steps_cover_rates_and_sort_arrivals(self):
+        from sagecal_tpu.fleet.loadgen import LoadSpec, build_schedule
+
+        spec = LoadSpec(arrival="ramp", rates=(0.5, 2.0, 4.0),
+                        step_s=10.0, seed=3)
+        arrivals, steps = build_schedule(spec)
+        assert [s["offered_rate"] for s in steps] == [0.5, 2.0, 4.0]
+        ts = [a["t"] for a in arrivals]
+        assert ts == sorted(ts)
+        # every arrival falls inside its step window, and per-step
+        # arrival counts are the recorded ground truth
+        for s in steps:
+            n = sum(1 for a in arrivals if s["t0"] <= a["t"] < s["t1"])
+            assert n == s["arrivals"]
+
+    def test_population_is_seeded_and_heterogeneous(self):
+        from sagecal_tpu.fleet.loadgen import LoadSpec, build_population
+
+        spec = LoadSpec(tenants=4, seed=5)
+        pop = build_population(spec)
+        assert len(pop) == 4
+        assert pop == build_population(spec)
+        # heterogeneity: weights decay, deadlines differ across tenants
+        assert pop[0].weight > pop[-1].weight
+        assert len({t.deadline_s for t in pop}) > 1
+
+
+# ---------------------------------------------------------------------------
+# knee detection vs an analytic oracle
+
+
+def _steps(rates, dur=10.0):
+    return [{"index": i, "t0": i * dur, "t1": (i + 1) * dur,
+             "offered_rate": r, "arrivals": int(r * dur)}
+            for i, r in enumerate(rates)]
+
+
+def _ok_result(rid, t_done, tenant="tenant0", wait=0.0, verdict="ok",
+               latency=0.5):
+    return {"request_id": rid, "tenant": tenant, "verdict": verdict,
+            "enqueued_at": t_done - latency, "started_at":
+            t_done - latency + wait, "completed_at": t_done,
+            "queue_wait_s": wait, "latency_s": latency}
+
+
+class TestKneeOracle:
+    def test_knee_at_first_unmet_rate(self):
+        """Served rate tracks offered up to capacity C=2/s, then caps:
+        the knee must land on the first offered rate above C."""
+        from sagecal_tpu.obs.capacity import find_knee, throughput_curve
+
+        cap, dur = 2.0, 10.0
+        rates = [0.5, 1.0, 2.0, 4.0]
+        results, k = [], 0
+        for i, r in enumerate(rates):
+            served = int(min(r, cap) * dur)
+            for j in range(served):
+                k += 1
+                results.append(_ok_result(
+                    f"r{k:04d}", i * dur + (j + 0.5) * dur / served))
+        curve = throughput_curve(_steps(rates, dur), results)
+        knee = find_knee(curve, tol=0.10)
+        assert knee["saturated"]
+        assert knee["knee_offered_rate"] == 4.0
+        assert knee["saturation_throughput"] == pytest.approx(cap)
+
+    def test_no_knee_when_fleet_keeps_up(self):
+        from sagecal_tpu.obs.capacity import find_knee, throughput_curve
+
+        dur, rates = 10.0, [0.5, 1.0]
+        results, k = [], 0
+        for i, r in enumerate(rates):
+            for j in range(int(r * dur)):
+                k += 1
+                results.append(_ok_result(
+                    f"r{k:04d}", i * dur + j / r + 0.1))
+        knee = find_knee(throughput_curve(_steps(rates, dur), results))
+        assert not knee["saturated"]
+        assert knee["knee_offered_rate"] is None
+
+    def test_window_spillover_at_low_rate_is_not_a_knee(self):
+        """At 0.5/s offered a single completion landing just past the
+        window edge is 10% of the step — batching latency, not
+        saturation.  The absolute guard (shortfall must be worth >2
+        whole requests) keeps the knee off such steps."""
+        from sagecal_tpu.obs.capacity import find_knee, throughput_curve
+
+        dur = 20.0
+        # 10 arrivals at 0.5/s; 9 complete in-window, 1 spills over
+        results = [_ok_result(f"r{j:02d}", (j + 0.4) * 2.0)
+                   for j in range(9)]
+        results.append(_ok_result("r09", dur + 0.3))
+        knee = find_knee(throughput_curve(_steps([0.5], dur), results),
+                         tol=0.10)
+        assert not knee["saturated"]
+
+    def test_shed_rate_attributed_by_arrival_step(self):
+        """Under overload most of the top step's sheds complete during
+        the DRAIN, after the last window closes.  The headline shed
+        rate must follow the arrivals (what happened to the load
+        offered in that step), not the completion windows."""
+        from sagecal_tpu.obs.capacity import arrival_dispositions
+
+        dur = 10.0
+        steps = _steps([1.0, 4.0], dur)
+        doc = {"t_start": 0.0, "steps": steps,
+               "submitted": (
+                   [{"t": j + 0.5, "request_id": f"a{j:02d}"}
+                    for j in range(10)]
+                   + [{"t": dur + j * 0.25, "request_id": f"b{j:02d}"}
+                      for j in range(40)])}
+        # step 0 fully served in-window; step 1: 10 served, 30 shed,
+        # every disposition completing after BOTH windows closed
+        results = [_ok_result(f"a{j:02d}", j + 1.0) for j in range(10)]
+        results += [_ok_result(f"b{j:02d}", 2 * dur + 1.0 + j,
+                               verdict="ok" if j < 10 else "shed")
+                    for j in range(40)]
+        mix = arrival_dispositions(doc, results)
+        assert mix[0]["arrival_shed_rate"] == 0.0
+        assert mix[1]["arrival_dispositions"] == 40
+        assert mix[1]["arrival_served"] == 10
+        assert mix[1]["arrival_shed"] == 30
+        assert mix[1]["arrival_shed_rate"] == pytest.approx(0.75)
+
+    def test_sheds_are_dispositions_not_served_work(self):
+        """The counting rule the reconciliation satellite pinned: a
+        shed manifest counts toward dispositions and the shed rate but
+        NEVER toward served throughput or goodput."""
+        from sagecal_tpu.obs.capacity import throughput_curve
+
+        dur = 10.0
+        results = [_ok_result(f"ok{i}", 2.0 + i) for i in range(4)]
+        results += [_ok_result(f"sh{i}", 3.0 + i, verdict="shed")
+                    for i in range(6)]
+        (row,) = throughput_curve(_steps([1.0], dur), results)
+        assert row["dispositions"] == 10
+        assert row["served"] == 4
+        assert row["throughput"] == pytest.approx(0.4)
+        assert row["shed"] == 6
+        assert row["shed_rate"] == pytest.approx(0.6)
+        assert row["goodput"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Little's law + live-vs-posthoc reconciliation fixtures
+
+
+def _dense_timeline(t0, t1, waiting, dt=0.5, **kw):
+    rows = []
+    t = t0
+    while t <= t1:
+        rows.append({"schema_version": 1, "kind": "fleet_timeline",
+                     "ts": t, "items": 100, "done": 0,
+                     "waiting": waiting, "leased": 0,
+                     "expired_leases": 0, "alive_workers": 2, **kw})
+        t += dt
+    return rows
+
+
+class TestLittlesLaw:
+    def _flow(self, n=40, lam=1.0, wait=2.0):
+        """Deterministic flow: one arrival per 1/lam seconds, each
+        waiting exactly ``wait`` s -> the waiting room holds lam*wait
+        items at every instant (L = λW exactly)."""
+        return [_ok_result(f"r{i:03d}", i / lam + wait + 0.3,
+                           wait=wait, latency=wait + 0.3)
+                for i in range(n)]
+
+    def test_agreeing_views_pass(self):
+        from sagecal_tpu.obs.capacity import littles_law_check
+
+        results = self._flow()
+        rows = _dense_timeline(2.0, 41.0, waiting=2)
+        chk = littles_law_check(rows, results)
+        assert chk["lambda_per_s"] == pytest.approx(1.0, rel=0.05)
+        assert chk["mean_wait_s"] == pytest.approx(2.0)
+        assert chk["live_ok"] and chk["posthoc_ok"] and chk["ok"]
+
+    def test_lying_live_view_fails(self):
+        """A timeline reporting 6x the true depth must DISAGREE while
+        the manifest reconstruction still agrees — the check isolates
+        which observability path is lying."""
+        from sagecal_tpu.obs.capacity import littles_law_check
+
+        results = self._flow()
+        rows = _dense_timeline(2.0, 41.0, waiting=12)
+        chk = littles_law_check(rows, results)
+        assert not chk["live_ok"]
+        assert chk["posthoc_ok"]
+        assert not chk["ok"]
+
+    def test_reconcile_pass_and_mismatch(self):
+        from sagecal_tpu.obs.capacity import reconcile_queue_views
+
+        results = self._flow()
+        good = reconcile_queue_views(
+            _dense_timeline(2.0, 41.0, waiting=2), results)
+        assert good["comparable"] and good["ok"]
+        bad = reconcile_queue_views(
+            _dense_timeline(2.0, 41.0, waiting=12), results)
+        assert bad["comparable"] and not bad["ok"]
+
+    def test_posthoc_depth_ignores_instant_sheds(self):
+        """An instant shed (enqueued_at == started_at) must not drive
+        the reconstructed depth negative (edge sort: arrivals before
+        departures at ties)."""
+        from sagecal_tpu.obs.aggregate import queue_depth_series
+
+        results = [_ok_result("s0", 5.0, verdict="shed", wait=0.0,
+                              latency=0.0)]
+        results[0]["started_at"] = results[0]["enqueued_at"]
+        series = queue_depth_series(results)
+        assert all(d >= 0 for _, d in series)
+
+
+# ---------------------------------------------------------------------------
+# recommender fire/clear hysteresis
+
+
+def _row(ts, waiting=0, leased=0, alive=2, burn=0.0):
+    return {"ts": ts, "waiting": waiting, "leased": leased,
+            "expired_leases": 0, "alive_workers": alive,
+            "slo_burn_max_short": burn}
+
+
+class TestRecommenderHysteresis:
+    def _rec(self, workers=2, **kw):
+        from sagecal_tpu.obs.capacity import (
+            AutoscaleRecommender, RecommenderConfig,
+        )
+
+        return AutoscaleRecommender(
+            RecommenderConfig(min_workers=1, max_workers=4, **kw),
+            workers)
+
+    def test_fires_only_after_consecutive_votes(self):
+        r = self._rec()
+        # first sample only seeds the growth window (slope needs two
+        # points), then the queue grows past the threshold with
+        # waiting > alive: the THIRD consecutive vote fires
+        assert r.update(_row(0.0, waiting=2)) is None
+        assert r.update(_row(1.0, waiting=4)) is None
+        assert r.update(_row(2.0, waiting=6)) is None
+        rec = r.update(_row(3.0, waiting=8))
+        assert rec is not None
+        assert rec["recommended_workers"] == 3
+        assert rec["previous_workers"] == 2
+        assert rec["reason"] == "queue_growth"
+
+    def test_neutral_sample_clears_the_count(self):
+        r = self._rec()
+        assert r.update(_row(0.0, waiting=4)) is None
+        assert r.update(_row(1.0, waiting=6)) is None
+        # busy-but-stable sample: neither up nor down vote
+        assert r.update(_row(2.0, waiting=1, leased=2)) is None
+        # two more growth votes are NOT enough after the reset
+        assert r.update(_row(3.0, waiting=6)) is None
+        assert r.update(_row(4.0, waiting=8)) is None
+        assert r.recommended == 2
+
+    def test_scale_down_on_sustained_idle_and_floor(self):
+        r = self._rec()
+        t, rec = 0.0, None
+        for _ in range(3):
+            rec = r.update(_row(t, waiting=0, leased=0))
+            t += 1.0
+        assert rec is not None and rec["reason"] == "idle"
+        assert r.recommended == 1
+        # at the floor: more idle votes never go below min_workers
+        for _ in range(6):
+            r.update(_row(t, waiting=0, leased=0))
+            t += 1.0
+        assert r.recommended == 1
+
+    def test_burn_path_and_ceiling(self):
+        r = self._rec(workers=4)
+        t = 0.0
+        for _ in range(6):
+            r.update(_row(t, waiting=3, burn=5.0))
+            t += 1.0
+        # already at max_workers: burn votes never exceed the ceiling
+        assert r.recommended == 4
+
+    def test_recommendation_file_round_trip(self, tmp_path):
+        from sagecal_tpu.obs.capacity import (
+            read_recommendation, write_recommendation,
+        )
+
+        rec = {"schema_version": 1, "ts": 1.0,
+               "recommended_workers": 3, "previous_workers": 2,
+               "reason": "queue_growth", "signals": {}}
+        write_recommendation(str(tmp_path), rec)
+        assert read_recommendation(str(tmp_path)) == rec
+        assert read_recommendation(str(tmp_path / "nope")) is None
+
+
+# ---------------------------------------------------------------------------
+# timeline sampler + validation
+
+
+class TestTimeline:
+    def test_sampler_rows_validate_and_sum(self, tmp_path):
+        from sagecal_tpu.fleet.queue import LeaseQueue, WorkItem
+        from sagecal_tpu.obs.timeline import (
+            TimelineSampler, read_timeline, validate_timeline,
+        )
+
+        q = LeaseQueue(str(tmp_path / "q"), worker="w0", ttl_s=30.0)
+        for i in range(3):
+            q.put(WorkItem(request_id=f"r{i}", tenant="t0",
+                           request={}, enqueued_at=float(i)))
+        q.claim("r0", now=100.0)
+        path = str(tmp_path / "timeline.jsonl")
+        with TimelineSampler(path, queue=q,
+                             clock=lambda: 100.0) as s:
+            row = s.sample(now=100.5, alive_workers=2)
+        assert row["items"] == 3 and row["leased"] == 1
+        assert row["waiting"] == 2 and row["alive_workers"] == 2
+        rows = read_timeline(path)
+        assert rows == [row]
+        assert validate_timeline(rows) == []
+
+    def test_sampler_counts_sheds_without_burning(self, tmp_path):
+        """Shed manifests show up in the verdict gauges but are NOT fed
+        to the SLO monitor (admission's anti-latch rule)."""
+        from sagecal_tpu.obs.slo import SLOSpec
+        from sagecal_tpu.obs.timeline import TimelineSampler
+
+        out = tmp_path / "out"
+        out.mkdir()
+        spec = {"t0": SLOSpec(tenant="t0", deadline_s=1.0,
+                              availability=0.9)}
+        doc = {"request_id": "a", "tenant": "t0", "verdict": "shed",
+               "completed_at": 100.0, "latency_s": 50.0}
+        (out / "a.result.json").write_text(json.dumps(doc))
+        with TimelineSampler(str(out / "timeline.jsonl"),
+                             out_dir=str(out), slo_specs=spec) as s:
+            row = s.sample(now=101.0)
+        assert row["results_total"] == 1 and row["shed_total"] == 1
+        assert row.get("slo_burn_max_short", 0.0) == 0.0
+
+    def test_validate_flags_broken_timelines(self):
+        from sagecal_tpu.obs.timeline import validate_timeline
+
+        assert validate_timeline([]) == ["no timeline rows"]
+        rows = _dense_timeline(0.0, 2.0, waiting=1)
+        rows[1]["items"] = 7  # counts no longer sum
+        del rows[2]["waiting"]
+        rows[2]["ts"] = -1.0  # not monotone
+        problems = validate_timeline(rows)
+        assert any("do not sum" in p for p in problems)
+        assert any("missing key waiting" in p for p in problems)
+        assert any("not monotone" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# stepped-load e2e vs a real two-worker fleet
+
+
+def _read_manifests(out_dir):
+    out = {}
+    for name in os.listdir(out_dir):
+        if name.endswith(".result.json"):
+            with open(os.path.join(out_dir, name)) as f:
+                doc = json.load(f)
+            out[doc["request_id"]] = doc
+    return out
+
+
+@pytest.mark.slow
+class TestLoadE2E:
+    def test_stepped_load_run_reconciles(self, tmp_path):
+        """A real seeded stepped-ramp load run against a spawned
+        two-worker fleet: queue drains, the live timeline validates,
+        Little's law holds across all three views, live and post-hoc
+        depth reconcile, and ``diag load`` exits 0."""
+        out = str(tmp_path / "run")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "sagecal_tpu.apps.cli", "load",
+             "--out-dir", out, "--workers", "2",
+             "--rates", "0.2,0.6", "--step", "15",
+             "--tenants", "2", "--seed", "23",
+             "--drain-timeout", "360"],
+            capture_output=True, text=True, timeout=900, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+        from sagecal_tpu.obs.timeline import (
+            read_timeline, timeline_path, validate_timeline,
+        )
+
+        rows = read_timeline(timeline_path(out))
+        assert validate_timeline(rows) == []
+
+        with open(os.path.join(out, "load_report.json")) as f:
+            report = json.load(f)
+        assert report["drained"]
+        assert report["served"] >= 1
+        assert report["littles_law"]["ok"], report["littles_law"]
+        assert report["reconcile"]["ok"], report["reconcile"]
+        # ground truth: every submitted arrival got a disposition
+        with open(os.path.join(out, "load_steps.json")) as f:
+            steps = json.load(f)
+        submitted = sum(s["arrivals"] for s in steps["steps"])
+        assert report["manifests"] == submitted
+
+        d = subprocess.run(
+            [sys.executable, "-m", "sagecal_tpu.obs.diag", "load",
+             out],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert d.returncode == 0, d.stdout + d.stderr
+        assert "LOAD: OK" in d.stdout
+
+    def test_recommender_off_path_is_bit_identical(self, tmp_path):
+        """With --elastic-workers off the recommender is report-only:
+        a fleet run with the timeline+recommender armed reproduces the
+        solutions of a --no-timeline run bit for bit."""
+        base = str(tmp_path / "base")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+        def fleet(out, *extra):
+            return subprocess.run(
+                [sys.executable, "-m", "sagecal_tpu.apps.cli",
+                 "fleet", "--synthetic", "4", "--tenants", "1",
+                 "--out-dir", out, "--workers", "2", "--batch", "2",
+                 "--max-idle", "30", "-j", "1", "-R"] + list(extra),
+                capture_output=True, text=True, timeout=600, env=env)
+
+        r = fleet(base, "--no-timeline")
+        assert r.returncode == 0, r.stdout + r.stderr
+        obs = str(tmp_path / "obs")
+        r = fleet(obs)
+        assert r.returncode == 0, r.stdout + r.stderr
+        a, b = _read_manifests(base), _read_manifests(obs)
+        assert set(a) == set(b) and len(a) == 4
+        # the observed run DID sample a timeline...
+        assert os.path.exists(os.path.join(obs, "timeline.jsonl"))
+        # ...and still produced bit-identical solutions
+        for rid in a:
+            sa = open(os.path.join(base, f"{rid}.solutions"),
+                      "rb").read()
+            sb = open(os.path.join(obs, f"{rid}.solutions"),
+                      "rb").read()
+            assert sa == sb, f"{rid}: solutions differ"

@@ -154,10 +154,10 @@ if probe; then
 fi
 echo "=== bf16-coherency fused bench"
 if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
-echo "=== telemetry+quality+trace+serve_obs+fleet+stream+sky+protocol+devprof test pass (CPU, marker-driven)"
+echo "=== telemetry+quality+trace+serve_obs+fleet+stream+sky+protocol+devprof+load test pass (CPU, marker-driven)"
 JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 1200 \
   python -m pytest tests/ -q \
-  -m "telemetry or quality or trace or serve_obs or fleet or stream or sky or protocol or devprof" \
+  -m "telemetry or quality or trace or serve_obs or fleet or stream or sky or protocol or devprof or load" \
   -p no:cacheprovider | tail -3
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then echo "telemetry test pass FAILED rc=$rc"; exit 1; fi
@@ -389,6 +389,40 @@ print("fleet smoke ok: 6/6 unique manifests complete after the kill")
 PY
 [ $? = 0 ] || { echo "fleet kill smoke FAILED"; exit 1; }
 rm -rf "$FLDIR"
+echo "=== load & capacity smoke (CPU, stepped load vs 2-worker fleet)"
+# the load harness end to end: a short seeded stepped-ramp run against
+# a real 2-worker fleet must drain, leave a structurally valid live
+# timeline, and pass the diag load cross-checks (Little's law across
+# the live/post-hoc/manifest views + depth reconciliation); the
+# report-only recommendation mirror, when present, must be well-formed
+LDDIR=$(mktemp -d)
+JAX_PLATFORMS=cpu timeout 560 python -m sagecal_tpu.apps.cli load \
+  --out-dir "$LDDIR" --workers 2 --rates 0.2,0.6 --step 12 \
+  --tenants 2 --seed 23 --drain-timeout 300 \
+  || { echo "load smoke run FAILED rc=$?"; exit 1; }
+JAX_PLATFORMS=cpu timeout 120 python -m sagecal_tpu.obs.diag load \
+  "$LDDIR" || { echo "DIAG LOAD FAILED (cross-checks disagree)"; exit 1; }
+JAX_PLATFORMS=cpu timeout 60 python - "$LDDIR" <<'PY'
+import json, os, sys
+from sagecal_tpu.obs.capacity import read_recommendation
+from sagecal_tpu.obs.timeline import (
+    read_timeline, timeline_path, validate_timeline)
+out = sys.argv[1]
+rows = read_timeline(timeline_path(out))
+problems = validate_timeline(rows)
+assert not problems, problems[:5]
+rec = read_recommendation(out)
+if rec is not None:
+    assert isinstance(rec["recommended_workers"], int), rec
+    assert rec.get("reason") and "signals" in rec, rec
+report = json.load(open(os.path.join(out, "load_report.json")))
+assert report["drained"] and report["littles_law"]["ok"], report["littles_law"]
+print("load smoke ok: %d samples, %d manifests, knee=%s" % (
+    len(rows), report["manifests"],
+    report["knee"]["knee_offered_rate"]))
+PY
+[ $? = 0 ] || { echo "load smoke validate FAILED"; exit 1; }
+rm -rf "$LDDIR"
 echo "=== widefield smoke (CPU, hier predict watchdog + kill-and-resume)"
 # the wide-field workload end to end: 300 sources collapsed to 3
 # tree-partitioned effective clusters, hierarchical coherencies
