@@ -1012,6 +1012,106 @@ def run_load_bench(rates=(0.5, 1.5, 6.0), step_s=20.0, workers=2,
     }
 
 
+def run_shadow_drift_bench(n_requests=4, timeout=900.0):
+    """Numerical-truth row: REAL cross-path drift distributions from
+    live shadow-audited serve runs (obs/shadow.py), banked as
+    gate-able p99 upper bounds.
+
+    Two small synthetic serve runs at ``--shadow-rate 1.0`` (every
+    request re-solved on the xla/f32 reference path after its manifest
+    lands), both routed through the fused batched kernels:
+
+    - ``shadow_drift_batched_vs_xla_p99``: fused_batch/f32 production
+      vs the reference — the pure KERNEL-PATH disagreement (vmap
+      batching + Pallas accumulation order);
+    - ``shadow_drift_bf16_vs_f32_p99``: fused_batch/bf16 production vs
+      the same reference — the bf16 coherency storage trade measured
+      on live traffic, the number the precision schedule (ROADMAP
+      item 1) wants watched continuously.
+
+    Both are the p99 upper BOUND of the max per-station gain relative
+    error, lifted from the ledger's merged histograms
+    (obs/drift.aggregate_drift) — the provable-interval discipline: the
+    bound provably contains the exact sampled max (pinned in
+    tests/test_drift.py).  Lower-better, cpu-wallclock evidence (the
+    drift RATIO is dtype/kernel truth, but it is measured on the CPU
+    interpret-mode kernels — a TPU MXU pass may differ; honest class
+    over flattering class).
+
+    Subprocess serve runs (like run_load_bench) with telemetry OFF:
+    ``SageConfig.collect_telemetry`` is a capability gate of the fused
+    batched path, and the bench must measure the path it names.
+    """
+    import shutil
+    import tempfile
+
+    from sagecal_tpu.obs.drift import aggregate_drift, drift_quantiles
+    from sagecal_tpu.obs.shadow import (
+        drift_path,
+        read_drift,
+        validate_drift,
+    )
+
+    workdir = tempfile.mkdtemp(prefix="sagecal-shadow-bench-")
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # telemetry collection forces the xla path (capability gate);
+        # a stray injected-drift env would poison the banked numbers
+        env.pop("SAGECAL_TELEMETRY", None)
+        env.pop("SAGECAL_SHADOW_INJECT_DRIFT", None)
+
+        def one(tag: str, coh_dtype: str):
+            out = os.path.join(workdir, tag)
+            proc = subprocess.run(
+                [sys.executable, "-m", "sagecal_tpu.apps.cli", "serve",
+                 "--synthetic", str(n_requests), "--tenants", "1",
+                 "--batch", "2", "--out-dir", out, "--f32", "--fused",
+                 "--coh-dtype", coh_dtype, "--shadow-rate", "1.0",
+                 "--shadow-budget-s", str(timeout)],
+                env=env, timeout=timeout, capture_output=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"shadow bench ({tag}) exited {proc.returncode}: "
+                    f"{proc.stderr.decode()[-800:]}")
+            rows = read_drift(drift_path(out))
+            problems = validate_drift(rows)
+            if problems or len(rows) != n_requests:
+                raise RuntimeError(
+                    f"shadow bench ({tag}) ledger invalid: "
+                    f"{len(rows)}/{n_requests} records, {problems}")
+            return rows
+
+        rows_f32 = one("f32", "f32")
+        rows_bf16 = one("bf16", "bf16")
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    def p99_bound(rows):
+        groups = aggregate_drift(rows)
+        quant = drift_quantiles(groups)
+        hi = max(quant[k]["gain_rel_err_max"]["p99"][1] for k in groups)
+        exact_max = max(float(r["gain_rel_err_max"]) for r in rows)
+        assert exact_max <= hi, (exact_max, hi)  # provable interval
+        return hi, exact_max
+
+    hi_f32, max_f32 = p99_bound(rows_f32)
+    hi_bf16, max_bf16 = p99_bound(rows_bf16)
+    return {
+        "n_requests": n_requests,
+        "kernel_path": rows_f32[0].get("kernel_path"),
+        "path_pairs": sorted({r["path_pair"]
+                              for r in rows_f32 + rows_bf16}),
+        "shadow_drift_batched_vs_xla_p99": float(f"{hi_f32:.3e}"),
+        "shadow_drift_bf16_vs_f32_p99": float(f"{hi_bf16:.3e}"),
+        "batched_gain_rel_err_exact_max": float(f"{max_f32:.3e}"),
+        "bf16_gain_rel_err_exact_max": float(f"{max_bf16:.3e}"),
+        "exceeded": sum(1 for r in rows_f32 + rows_bf16
+                        if r.get("verdict") != "ok"),
+        "shadow_s_total": round(sum(float(r.get("shadow_s", 0.0))
+                                    for r in rows_f32 + rows_bf16), 2),
+    }
+
+
 def run_widefield_bench(nsources=10000, nblobs=40, nstations=40,
                         order=8, theta=1.5, repeats=5, seed=3):
     """Wide-field hierarchical-predict row: compiled memory traffic and
@@ -1396,6 +1496,18 @@ def main(argv=None):
             except Exception as exc:  # never sink the headline bench
                 sys.stderr.write(f"bench: load bench failed: {exc}\n")
 
+    # numerical-truth row: live shadow-audited serve runs (fused f32 +
+    # fused bf16 vs the xla/f32 reference) banking real cross-path
+    # drift distributions.  SAGECAL_BENCH_NO_SHADOW=1 skips it.
+    shadow_rec = None
+    if not os.environ.get("SAGECAL_BENCH_NO_SHADOW"):
+        with tracer.span("bench", kind="run", variant="shadow"):
+            try:
+                shadow_rec = run_shadow_drift_bench()
+            except Exception as exc:  # never sink the headline bench
+                sys.stderr.write(
+                    f"bench: shadow-drift bench failed: {exc}\n")
+
     # wide-field hierarchical-predict row: compiled-traffic ratio vs the
     # exact predict at the 10k-source shape + sampled error at the
     # default (order, theta) knob.  SAGECAL_BENCH_NO_WIDEFIELD=1 skips.
@@ -1577,6 +1689,16 @@ def main(argv=None):
         rec["goodput_fraction_at_saturation"] = (
             load_rec["goodput_fraction_at_saturation"])
         rec["load_bench"] = load_rec
+    if shadow_rec is not None:
+        # gate-able numerical-truth rows (obs/perf.py knows the
+        # directions, both lower-better): p99 upper bounds of the max
+        # per-station gain relative error, production vs xla/f32
+        # reference, from live shadow-audited runs
+        rec["shadow_drift_batched_vs_xla_p99"] = (
+            shadow_rec["shadow_drift_batched_vs_xla_p99"])
+        rec["shadow_drift_bf16_vs_f32_p99"] = (
+            shadow_rec["shadow_drift_bf16_vs_f32_p99"])
+        rec["shadow_drift_bench"] = shadow_rec
     if widefield_rec is not None:
         # gate-able wide-field hierarchical-predict rows (obs/perf.py
         # knows the directions): compiled-traffic ratio higher-better,

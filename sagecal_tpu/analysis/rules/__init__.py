@@ -21,6 +21,7 @@ from sagecal_tpu.analysis.rules.jl008 import NonAtomicProtocolWrite
 from sagecal_tpu.analysis.rules.jl009 import UnguardedPickleLoad
 from sagecal_tpu.analysis.rules.jl010 import RawClockInLeaseLogic
 from sagecal_tpu.analysis.rules.jl011 import UseAfterDonation
+from sagecal_tpu.analysis.rules.jl012 import MixedDtypeComparison
 from sagecal_tpu.analysis.rules.jl900 import DeadImport
 
 
@@ -37,5 +38,6 @@ def all_rules() -> List[Type[Rule]]:
         UnguardedPickleLoad,
         RawClockInLeaseLogic,
         UseAfterDonation,
+        MixedDtypeComparison,
         DeadImport,
     ]

@@ -244,6 +244,23 @@ class ServeConfig:
     # threads reaped) and transparently reopened from its remaining
     # tiles on next touch; serve_prefetch_evictions_total counts it.
     max_streams: int = 0
+    # shadow-solve differential auditing (obs/shadow.py): re-solve this
+    # fraction of requests on the reference path (XLA, f32 coherencies,
+    # single lane) AFTER each result manifest is written, and append a
+    # drift record to <out_dir>/drift.jsonl.  Sampling is a pure
+    # function of (shadow_seed, request_id); 0 disables auditing
+    # entirely — provably byte-identical output to a build without the
+    # feature (tests/test_drift.py)
+    shadow_rate: float = 0.0
+    shadow_seed: int = 0
+    # per-process wall-clock budget for shadow re-solves; once spent,
+    # further sampled requests are skipped and COUNTED (diag drift
+    # reports the skip count, so a starved budget can't look clean)
+    shadow_budget_s: float = 120.0
+    # escalate a tolerance-policy breach (obs/shadow.DRIFT_TOLERANCES)
+    # from report-only to a run abort (exit 3), raised only after the
+    # whole run's manifests + drift ledger are on disk
+    abort_on_drift: bool = False
 
 
 @dataclasses.dataclass
@@ -323,6 +340,15 @@ class FleetConfig:
     # AFTER workers start, so "every item submitted so far is done" is
     # not an exit signal — workers hold on until max_idle_s or SIGTERM
     open_loop: bool = False
+    # shadow-solve differential auditing (ServeConfig semantics): each
+    # worker audits its own claimed requests against the XLA/f32
+    # reference, appending to the SHARED <out_dir>/drift.jsonl (the
+    # O_APPEND single-write contract keeps concurrent workers from
+    # interleaving); the budget is per worker
+    shadow_rate: float = 0.0
+    shadow_seed: int = 0
+    shadow_budget_s: float = 120.0
+    abort_on_drift: bool = False
 
 
 @dataclasses.dataclass

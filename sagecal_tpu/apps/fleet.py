@@ -96,6 +96,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-tenant SLO specs (slo.json); also drives "
                     "admission control deadlines; falls back to a "
                     "'slos' key in the request manifest")
+    ap.add_argument("--shadow-rate", type=float, default=0.0,
+                    help="fraction of requests each worker shadow "
+                    "re-solves on the XLA/f32 reference path after "
+                    "their manifests land, appending drift records to "
+                    "the shared <out-dir>/drift.jsonl (obs/shadow.py)")
+    ap.add_argument("--shadow-budget-s", type=float, default=120.0,
+                    help="per-worker wall-clock budget for shadow "
+                    "re-solves; sampled requests past it are skipped "
+                    "and counted")
+    ap.add_argument("--shadow-seed", type=int, default=0,
+                    help="sampler seed: same seed -> same sampled "
+                    "request ids fleet-wide, whichever worker claims")
+    ap.add_argument("--abort-on-drift", action="store_true",
+                    help="workers escalate a drift-tolerance breach "
+                    "from report-only to an abort")
     ap.add_argument("-V", "--verbose", action="store_true")
     ap.add_argument("--no-timeline", action="store_true",
                     help="disable the coordinator's live timeline "
@@ -155,7 +170,10 @@ def config_from_args(args) -> FleetConfig:
         max_respawns=args.max_respawns,
         elastic_workers=args.elastic_workers,
         min_workers=args.min_workers, max_workers=args.max_workers,
-        open_loop=args.open_loop)
+        open_loop=args.open_loop, shadow_rate=args.shadow_rate,
+        shadow_budget_s=args.shadow_budget_s,
+        shadow_seed=args.shadow_seed,
+        abort_on_drift=args.abort_on_drift)
 
 
 def _obs_setup(cfg, role: str):

@@ -59,6 +59,22 @@ def build_parser() -> argparse.ArgumentParser:
                     "paths (bf16 halves the dominant HBM stream, f32 "
                     "accumulation)")
     ap.add_argument("--abort-on-divergence", action="store_true")
+    ap.add_argument("--shadow-rate", type=float, default=0.0,
+                    help="fraction of requests to shadow re-solve on "
+                    "the XLA/f32 reference path after their manifests "
+                    "land, appending drift records to "
+                    "<out-dir>/drift.jsonl (obs/shadow.py); 0 = off, "
+                    "bit-identical to no feature")
+    ap.add_argument("--shadow-budget-s", type=float, default=120.0,
+                    help="wall-clock budget for shadow re-solves; "
+                    "sampled requests past it are skipped + counted")
+    ap.add_argument("--shadow-seed", type=int, default=0,
+                    help="sampler seed: same seed -> same sampled "
+                    "request ids, independent of scheduling")
+    ap.add_argument("--abort-on-drift", action="store_true",
+                    help="escalate a drift-tolerance breach "
+                    "(obs/shadow.DRIFT_TOLERANCES) from report-only to "
+                    "a run abort (exit 3) after the drain")
     ap.add_argument("--resume", action="store_true",
                     help="skip requests a previous (preempted) server "
                     "run already completed (per-tenant checkpoints)")
@@ -91,7 +107,10 @@ def config_from_args(args) -> ServeConfig:
         checkpoint_dir=args.checkpoint_dir, use_f64=not args.f32,
         use_fused_predict=args.fused, coh_dtype=args.coh_dtype,
         verbose=args.verbose, slo=args.slo, aot_store=args.aot_store,
-        max_streams=args.max_streams)
+        max_streams=args.max_streams, shadow_rate=args.shadow_rate,
+        shadow_budget_s=args.shadow_budget_s,
+        shadow_seed=args.shadow_seed,
+        abort_on_drift=args.abort_on_drift)
 
 
 def run_serve(cfg: ServeConfig, requests=None, log=print):

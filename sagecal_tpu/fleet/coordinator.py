@@ -110,6 +110,18 @@ def worker_argv(cfg, index: int) -> List[str]:
         argv += ["--open-loop"]
     if not cfg.use_f64:
         argv += ["--f32"]
+    if getattr(cfg, "use_fused_predict", False):
+        argv += ["--fused"]
+    if getattr(cfg, "coh_dtype", "f32") != "f32":
+        argv += ["--coh-dtype", cfg.coh_dtype]
+    if float(getattr(cfg, "shadow_rate", 0.0) or 0.0) > 0.0:
+        argv += ["--shadow-rate", str(cfg.shadow_rate),
+                 "--shadow-budget-s",
+                 str(getattr(cfg, "shadow_budget_s", 120.0)),
+                 "--shadow-seed",
+                 str(getattr(cfg, "shadow_seed", 0))]
+        if getattr(cfg, "abort_on_drift", False):
+            argv += ["--abort-on-drift"]
     if cfg.verbose:
         argv += ["-V"]
     return argv

@@ -92,6 +92,18 @@ class FleetWorker:
         self._hold_lock = threading.Lock()
         self.cycles = 0
         self.solved = 0
+        # ONE shadow auditor for the worker's whole life (like the
+        # executable cache): the wall-clock budget is per WORKER, not
+        # per claim cycle, and every cycle's service gets it injected
+        self.shadow = None
+        if float(getattr(cfg, "shadow_rate", 0.0) or 0.0) > 0.0:
+            from sagecal_tpu.obs.shadow import ShadowAuditor
+
+            self.shadow = ShadowAuditor(
+                cfg.out_dir, rate=cfg.shadow_rate,
+                budget_s=float(getattr(cfg, "shadow_budget_s", 120.0)),
+                seed=int(getattr(cfg, "shadow_seed", 0)),
+                device=device, log=log)
 
     # -- config plumbing ----------------------------------------------
 
@@ -115,7 +127,16 @@ class FleetWorker:
             use_fused_predict=getattr(c, "use_fused_predict", False),
             coh_dtype=getattr(c, "coh_dtype", "f32"),
             verbose=c.verbose, slo="",
-            max_streams=c.max_streams)
+            max_streams=c.max_streams,
+            # shadow auditing rides the per-cycle service: every worker
+            # appends to the SHARED <out_dir>/drift.jsonl (O_APPEND
+            # single-write rows never interleave); the sampler is a
+            # pure function of (seed, request_id) so the fleet agrees
+            # on the sample with no coordination
+            shadow_rate=float(getattr(c, "shadow_rate", 0.0) or 0.0),
+            shadow_seed=int(getattr(c, "shadow_seed", 0)),
+            shadow_budget_s=float(getattr(c, "shadow_budget_s", 120.0)),
+            abort_on_drift=bool(getattr(c, "abort_on_drift", False)))
 
     # -- lease upkeep --------------------------------------------------
 
@@ -168,6 +189,7 @@ class FleetWorker:
         svc = CalibrationService(self._serve_cfg(), log=self.log,
                                  device=self.device)
         svc.cache = self.cache  # persistent in-proc + AOT store tiers
+        svc.shadow = self.shadow  # worker-lifetime audit budget
         svc.run(reqs, elog=elog)
         for it, degraded in items:
             if degraded:
@@ -278,6 +300,11 @@ class FleetWorker:
             "res_1": float(cost), "mean_nu": 0.0,
             "bucket": f"sharded:{len(devs)}dev", "batch": 1, "lane": 0,
             "placed": "sharded_joint_fit",
+            "kernel_path": "sharded",
+            "kernel_path_reason": (
+                f"nstations={N} >= large_stations="
+                f"{cfg.large_stations}: row-sharded joint fit over "
+                f"{len(devs)} devices"),
             "iterations": int(iterations),
             "solutions": out_path,
             "enqueued_at": item.enqueued_at, "started_at": t_start,
@@ -480,6 +507,9 @@ class FleetWorker:
             "cache": self.cache.stats(),
             "admission": dict(self.admission.decisions),
         }
+        if self.shadow is not None:
+            summary["shadow"] = self.shadow.stats()
+            self.shadow.close()
         if reg.enabled:
             from sagecal_tpu.obs.aggregate import (
                 metrics_snapshot_path, write_metrics_snapshot,

@@ -76,6 +76,13 @@ Subcommands:
   reconstruction, and the latest autoscale recommendation.  Exit 1
   when the timeline is missing/invalid or the cross-checks disagree.
 
+- ``drift OUT_DIR... [--report FILE]`` — numerical-drift report from
+  shadow-audit ledgers (``drift.jsonl``; :mod:`sagecal_tpu.obs.shadow`):
+  per-(path-pair, bucket, dtype) distributions with provable quantile
+  bounds against the central tolerance policy
+  (``shadow.DRIFT_TOLERANCES``).  Exit 1 on any tolerance breach or
+  structural ledger problem; exit 0 with a warning when no samples.
+
 Runs standalone (``python -m sagecal_tpu.obs.diag ...``) or via the
 ``diag`` subcommand of the main CLI (:mod:`sagecal_tpu.apps.cli`).
 """
@@ -612,12 +619,20 @@ def _cmd_serve(args) -> int:
         by_bucket.setdefault(str(r.get("bucket", "?")), []).append(r)
     if by_bucket:
         print("\nper-bucket:")
-        print(f"{'bucket':<28s}{'n':>5s}{'p50_s':>9s}{'max_s':>9s}")
+        print(f"{'bucket':<28s}{'n':>5s}{'p50_s':>9s}{'max_s':>9s}"
+              "  kernel_path")
         for b in sorted(by_bucket):
             lats = sorted(float(r.get("latency_s", 0.0))
                           for r in by_bucket[b])
+            # which kernel actually solved this bucket's requests —
+            # stamped per manifest by the service (the capability
+            # check is per (bucket, fingerprint), so mixed values
+            # here mean the bucket re-routed mid-run)
+            paths = sorted({str(r.get("kernel_path", "?"))
+                            for r in by_bucket[b]})
             print(f"{b:<28s}{len(lats):>5d}"
-                  f"{_percentile(lats, 0.5):>9.3f}{lats[-1]:>9.3f}")
+                  f"{_percentile(lats, 0.5):>9.3f}{lats[-1]:>9.3f}"
+                  f"  {'+'.join(paths)}")
     hits = state_counter_total(
         state, "serve_executable_cache_hits_total")
     misses = state_counter_total(
@@ -792,6 +807,49 @@ def _cmd_load(args) -> int:
             f.write("\n")
         print(f"report -> {args.report}")
     print("LOAD: " + ("UNHEALTHY" if rc else "OK"))
+    return rc
+
+
+def _cmd_drift(args) -> int:
+    """Numerical-drift report of serve/fleet out-dirs: per-(path-pair,
+    bucket, dtype) shadow-audit distributions with provable quantile
+    bounds against the central tolerance policy.  Exit 1 on any
+    tolerance breach or structural ledger problem; exit 0 with a
+    warning when no samples exist (shadow auditing off)."""
+    from sagecal_tpu.obs.drift import analyze_drift, format_drift_report
+    from sagecal_tpu.obs.shadow import (
+        drift_path, read_drift, validate_drift,
+    )
+
+    rows = []
+    for d in args.out_dir:
+        path = d if os.path.isfile(d) else drift_path(d)
+        rows.extend(read_drift(path))
+    rows.sort(key=lambda r: float(r.get("ts", 0.0)))
+    rc = 0
+    problems = validate_drift(rows) if rows else []
+    if problems:
+        print("drift ledger: INVALID", file=sys.stderr)
+        for p in problems[:10]:
+            print(f"  {p}", file=sys.stderr)
+        rc = 1
+    report = analyze_drift(rows, validate_problems=problems)
+    for line in format_drift_report(report):
+        print(line)
+    if report["n_exceeded"]:
+        rc = 1
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True,
+                      default=float)
+            f.write("\n")
+        print(f"report -> {args.report}")
+    if not rows:
+        # no samples is WARN-not-fail: a rate-0 run has nothing to
+        # gate, and failing would force shadow auditing on everyone
+        print("DRIFT: NO SAMPLES (warn)")
+        return 0
+    print("DRIFT: " + ("EXCEEDED" if rc else "OK"))
     return rc
 
 
@@ -996,6 +1054,20 @@ def build_parser() -> argparse.ArgumentParser:
                      help="also write the machine-readable JSON "
                           "report here")
     ldp.set_defaults(fn=_cmd_load)
+
+    dp = sub.add_parser(
+        "drift",
+        help="numerical-drift report from shadow-audit ledgers: "
+             "per-(path-pair, bucket, dtype) distributions vs the "
+             "central tolerance policy (exit 1 on any breach; exit 0 "
+             "+ warning when no samples)",
+    )
+    dp.add_argument("out_dir", nargs="+",
+                    help="serve/fleet --out-dir(s) holding drift.jsonl "
+                         "(a ledger file path also works)")
+    dp.add_argument("--report", default=None,
+                    help="also write the machine-readable JSON report")
+    dp.set_defaults(fn=_cmd_drift)
 
     qp = sub.add_parser(
         "quality",

@@ -156,6 +156,13 @@ def bench_evidence_classes(platform: Optional[str]) -> Dict[str, str]:
         "saturation_throughput_solves_per_sec": "cpu-wallclock",
         "shed_rate_under_overload": "cpu-wallclock",
         "goodput_fraction_at_saturation": "cpu-wallclock",
+        # numerical-truth rows (bench.run_shadow_drift_bench): the
+        # drift ratio is dtype/kernel truth, but it is measured on the
+        # CPU interpret-mode kernels — a TPU MXU pass may round
+        # differently, so the class is honest cpu-wallclock, never a
+        # device claim
+        "shadow_drift_batched_vs_xla_p99": "cpu-wallclock",
+        "shadow_drift_bf16_vs_f32_p99": "cpu-wallclock",
         # wall-clock headline + serve/coherency rows follow the run's
         # platform: bench measures them on the live device
         "value": wall,

@@ -907,6 +907,11 @@ GATE_LOWER_BETTER = (
     # rate is admission-POLICY-shaped, not pure capacity, so it is
     # direction-tagged here but left out of GATE_DEFAULT_METRICS
     "shed_rate_under_overload",
+    # numerical-truth rows (bench.run_shadow_drift_bench): p99 upper
+    # bounds of live cross-path gain drift — a RISE means a kernel
+    # path's numerics moved away from the xla/f32 reference
+    "shadow_drift_batched_vs_xla_p99",
+    "shadow_drift_bf16_vs_f32_p99",
 )
 # the metrics gated when present in BOTH records (others opt in via
 # --metric name=tol)
@@ -921,6 +926,7 @@ GATE_DEFAULT_METRICS = (
     "hier_predict_speedup", "hier_predict_max_rel_err",
     "saturation_throughput_solves_per_sec",
     "goodput_fraction_at_saturation",
+    "shadow_drift_batched_vs_xla_p99", "shadow_drift_bf16_vs_f32_p99",
 )
 GATE_DEFAULT_TOLERANCE = 0.10
 

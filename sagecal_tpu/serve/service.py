@@ -210,6 +210,11 @@ class CalibrationService:
         self._latencies: List[float] = []
         self._diverged_abort: Optional[tuple] = None
         self._slo = None  # SLOMonitor, built in run() from cfg.slo
+        # shadow-solve auditor (obs/shadow.py), built in run() iff
+        # cfg.shadow_rate > 0 — with the rate at 0 no auditor object
+        # exists and the dispatch path is byte-identical to a build
+        # without the feature (pinned in tests/test_drift.py)
+        self.shadow = None
 
     # -- data loading --------------------------------------------------
 
@@ -347,17 +352,30 @@ class CalibrationService:
         # _finish_request, or a padded tail lane could fire a spurious
         # quality_degraded / solver_diverged verdict for a request that
         # already has its real verdict from its own lane.
+        lane_quality = {}
         for lane in range(len(idx)):
             if not valid[lane]:
                 continue
+            lane_quality[lane] = (
+                None if out.quality is None else jax.tree_util.tree_map(
+                    lambda x: x[lane], out.quality))
             self._finish_request(
                 entries[lane], bucket, lane, len(idx),
                 p_host[lane], float(res0_host[lane]),
                 float(res1_host[lane]), bool(div_host[lane]),
-                float(nu_host[lane]),
-                None if out.quality is None else jax.tree_util.tree_map(
-                    lambda x: x[lane], out.quality),
-                elog, timing)
+                float(nu_host[lane]), lane_quality[lane],
+                elog, timing, kernel_path, path_reason)
+        if self.shadow is not None:
+            # shadow audits run strictly AFTER every manifest of the
+            # batch is on disk — the re-solve shares the process but
+            # never the latency path of any request in flight
+            for lane in range(len(idx)):
+                if not valid[lane]:
+                    continue
+                self.shadow.audit(
+                    entries[lane], bucket.short(), kernel_path,
+                    path_reason, p_host[lane],
+                    float(res1_host[lane]), lane_quality[lane], elog)
 
     @staticmethod
     def _compile_seconds_by_name(name: str) -> float:
@@ -375,7 +393,8 @@ class CalibrationService:
 
     def _finish_request(self, entry: _Entry, bucket, lane, batch,
                         p, res0, res1, diverged, mean_nu, quality,
-                        elog, timing) -> None:
+                        elog, timing, kernel_path: str = "xla",
+                        path_reason: str = "") -> None:
         from sagecal_tpu.core.types import params_to_jones
         from sagecal_tpu.io import solutions as solio
         from sagecal_tpu.obs.quality import check_and_emit
@@ -427,6 +446,11 @@ class CalibrationService:
             "verdict": verdict, "reasons": reasons,
             "res_0": res0, "res_1": res1, "mean_nu": mean_nu,
             "bucket": bucket.short(), "batch": batch, "lane": lane,
+            # which kernel actually solved this request, and why the
+            # capability check chose it — the bench already stamps
+            # this; operators get it per result (diag serve columns)
+            "kernel_path": kernel_path,
+            "kernel_path_reason": path_reason,
             "solutions": out_path,
             # wall-clock lifecycle: latency reconstructable from the
             # manifest alone, no live gauges needed
@@ -546,6 +570,20 @@ class CalibrationService:
         t_start = time.time()
         os.makedirs(cfg.out_dir, exist_ok=True)
         self._slo = self._build_slo_monitor()
+        shadow_owned = False
+        if self.shadow is None \
+                and float(getattr(cfg, "shadow_rate", 0.0) or 0.0) > 0.0:
+            # a fleet worker injects its own persistent auditor before
+            # run() (budget is per WORKER, not per claim cycle); the
+            # standalone service builds and owns one per run
+            from sagecal_tpu.obs.shadow import ShadowAuditor
+
+            self.shadow = ShadowAuditor(
+                cfg.out_dir, rate=cfg.shadow_rate,
+                budget_s=float(getattr(cfg, "shadow_budget_s", 60.0)),
+                seed=int(getattr(cfg, "shadow_seed", 0)),
+                device=self.device, log=self.log)
+            shadow_owned = True
 
         # -- per-tenant elastic state: which requests already finished
         tenants = list(dict.fromkeys(r.tenant for r in requests))
@@ -699,6 +737,8 @@ class CalibrationService:
             # on an error path pool.close() reaps the still-open ones
             # (crash-flusher contract: no leaked reader threads)
             pool.close()
+            if self.shadow is not None and shadow_owned:
+                self.shadow.close()
             for mgr in ckmgrs.values():
                 mgr.flush()
                 mgr.close()
@@ -730,6 +770,8 @@ class CalibrationService:
             "prefetch_evictions": pool.evictions,
             "results": self._results,
         }
+        if self.shadow is not None:
+            summary["shadow"] = self.shadow.stats()
         if self._slo is not None and self._slo.enabled:
             summary["slo"] = self._slo.evaluate(registry=reg)
         if elog is not None:
@@ -741,4 +783,14 @@ class CalibrationService:
             raise DivergenceAbort(
                 f"request {rid} (tile {t0}) diverged: "
                 f"{'; '.join(reasons)}")
+        if self.shadow is not None and self.shadow.exceeded \
+                and getattr(cfg, "abort_on_drift", False):
+            # opt-in escalation, after every manifest and the full
+            # drift ledger are on disk (report-only is the default —
+            # the shipped results may well be fine; the ledger exists
+            # so this decision is explicit)
+            raise DivergenceAbort(
+                "shadow drift exceeded tolerance for request(s) "
+                + ", ".join(self.shadow.exceeded)
+                + "; aborting (abort_on_drift)")
         return summary

@@ -1,0 +1,38 @@
+"""JL012 must-not-fire fixture: the precision carve-outs."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def dispatch(coh_dtype, coh):
+    # string-literal dtype dispatch is configuration, not numerics
+    if coh_dtype == "bf16":
+        coh = coh.astype(jnp.bfloat16)
+    return coh
+
+
+def same_family(cost_f32, ref_f32):
+    # both sides in one float family: no implicit tolerance
+    return cost_f32 < ref_f32
+
+
+def single_family(x, limit):
+    # only one side carries dtype intent — nothing mixed
+    x_bf16 = x.astype(jnp.bfloat16)
+    return x_bf16.sum() > limit
+
+
+def check_stated(a, b):
+    # explicit tolerance: the check states what "close" means
+    return np.allclose(a, b, rtol=1e-3, atol=1e-6)
+
+
+def check_positional(a, b):
+    # positional rtol counts as stated
+    return np.isclose(a, b, 1e-3)
+
+
+def stringly(kind_bf16):
+    # string-literal comparator: dtype dispatch, not numerics, even
+    # when the left-hand name carries a family token
+    return kind_bf16 == "f32"

@@ -1,0 +1,20 @@
+"""JL012 should-fire fixture (lives under a solvers/ path segment)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def converged(cost_bf16, cost_f32):
+    # JL012: compares a bf16-family value against an f32-family one —
+    # the upcast encodes an implicit half-precision tolerance
+    return cost_bf16 < cost_f32
+
+
+def gate(coh_bf16, ref):
+    ref_f32 = ref.astype(jnp.float32)
+    return coh_bf16.max() > ref_f32.max()  # JL012: mixed families
+
+
+def check(a, b):
+    # JL012: tolerance-less allclose leans on dtype-blind defaults
+    return np.allclose(a, b)

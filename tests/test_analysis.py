@@ -65,6 +65,7 @@ FIRE_CASES = [
     ("JL009", "jl009_fire.py", 2),
     ("JL010", os.path.join("fleet", "jl010_fire.py"), 2),
     ("JL011", "jl011_fire.py", 2),
+    ("JL012", os.path.join("solvers", "jl012_fire.py"), 3),
     ("JL900", "jl900_fixture.py", 2),
 ]
 
@@ -79,6 +80,7 @@ CLEAN_CASES = [
     ("JL009", "jl009_clean.py"),
     ("JL010", os.path.join("fleet", "jl010_clean.py")),
     ("JL011", "jl011_clean.py"),
+    ("JL012", os.path.join("solvers", "jl012_clean.py")),
 ]
 
 
@@ -113,6 +115,26 @@ class TestRuleFixtures:
     def test_report_only_does_not_gate(self):
         rc = lint_cli.main([fx("jl900_fixture.py")])
         assert rc == 0
+
+    def test_jl012_report_only_with_baselined_why(self, tmp_path):
+        # JL012 never gates on its own ...
+        fire = fx(os.path.join("solvers", "jl012_fire.py"))
+        assert lint_cli.main([fire]) == 0
+        findings = [f for f in rules_fired(fire) if f.rule == "JL012"]
+        assert findings and all(f.report_only for f in findings)
+        # ... and the deliberate-case discipline is a baseline record
+        # carrying a `why` (the shipped tree is currently clean under
+        # JL012, so the mechanism is pinned on the fixture)
+        bl_path = str(tmp_path / "bl.json")
+        baseline_mod.save_baseline(bl_path, findings)
+        data = json.load(open(bl_path))
+        data["findings"][0]["why"] = ("deliberate: storage-precision "
+                                      "equality is the intent")
+        with open(bl_path, "w") as f:
+            json.dump(data, f)
+        baseline_mod.save_baseline(bl_path, findings)
+        data2 = json.load(open(bl_path))
+        assert [r for r in data2["findings"] if r.get("why")]
 
 
 class TestCallGraph:
@@ -225,7 +247,7 @@ class TestCLI:
         out = capsys.readouterr().out
         for rid in ("JL001", "JL002", "JL003", "JL004", "JL005",
                     "JL006", "JL007", "JL008", "JL009", "JL010",
-                    "JL011", "JL900"):
+                    "JL011", "JL012", "JL900"):
             assert rid in out
         assert "report-only" in out
 
@@ -240,7 +262,10 @@ class TestCLI:
         # every report-only finding is recorded in the committed
         # baseline (known-and-decided, e.g. JL007 carries whose callers
         # reuse the args tuple), and the full-package run stays under
-        # the CI budget (10 s)
+        # the CI budget.  The budget is a pre-commit-usability bound:
+        # ~4 s idle, so 25 s still catches an accidentally quadratic
+        # rule while surviving the 2-3x slowdown of the full suite's
+        # subprocess tests sharing the cores.
         findings, stats, _ = analyze_paths([PKGDIR])
         gate = [f for f in findings if not f.report_only]
         assert gate == [], gate
@@ -257,7 +282,7 @@ class TestCLI:
         undecided = [f for f in findings
                      if f.report_only and rel_key(f) not in known]
         assert undecided == [], undecided
-        assert stats["elapsed_seconds"] < 10.0, stats
+        assert stats["elapsed_seconds"] < 25.0, stats
 
     def test_module_entry_points_agree(self):
         import subprocess

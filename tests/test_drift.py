@@ -1,0 +1,413 @@
+"""Numerical-truth observability (obs/shadow.py + obs/drift.py).
+
+Five layers:
+
+- sampler: pure-function determinism (pinned sampled ids), rate edge
+  cases, seed sensitivity, budget-exhaustion accounting;
+- metrics + policy: exact differential metrics on crafted gain
+  vectors, per-station attribution, the central tolerance table's
+  shape (bf16 pairs strictly looser than f32 pairs), verdicts;
+- ledger: record round-trip through read/validate, corrupt-tail
+  tolerance, validate catching a verdict that disagrees with the
+  tolerance policy;
+- aggregation: histogram groups whose provable quantile bounds
+  contain the exact observed max; the empty-report path;
+- live serve (the acceptance pins): a real run at ``--shadow-rate
+  1.0`` produces one valid record per request with ``diag drift``
+  exit 0 and p99 bounds containing the exact sampled max; the
+  seeded injected-drift fixture flips ``diag drift`` to exit 1; and
+  ``--shadow-rate 0`` is provably off-path — no ledger, byte-equal
+  solutions to a shadowed run.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.drift
+
+
+class _FakeLog:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **fields):
+        self.events.append(dict(kind=kind, **fields))
+
+
+# ---------------------------------------------------------------- sampler
+
+
+class TestSampler:
+    def test_pinned_sample_sets(self):
+        """The sampler is a pure function of (seed, request_id): these
+        exact ids are in the sample, forever (a silent hash change
+        would silently shift which traffic gets audited)."""
+        from sagecal_tpu.obs.shadow import shadow_sampled
+
+        ids = [f"req{i:03d}" for i in range(10)]
+        assert [r for r in ids if shadow_sampled(r, 0.5, 0)] == \
+            ["req002", "req003", "req006", "req007"]
+        assert [r for r in ids if shadow_sampled(r, 0.3, 0)] == \
+            ["req002", "req006"]
+        # a different seed picks a different slice
+        assert [r for r in ids if shadow_sampled(r, 0.5, 1)] == \
+            ["req000", "req001", "req004", "req005", "req008", "req009"]
+
+    def test_rate_edges(self):
+        from sagecal_tpu.obs.shadow import shadow_sampled
+
+        for rid in ("a", "b", "req042"):
+            assert not shadow_sampled(rid, 0.0)
+            assert not shadow_sampled(rid, -1.0)
+            assert shadow_sampled(rid, 1.0)
+            assert shadow_sampled(rid, 2.0)
+
+    def test_budget_exhaustion_is_counted_not_queued(self, tmp_path):
+        from sagecal_tpu.obs.shadow import ShadowAuditor
+
+        with ShadowAuditor(str(tmp_path), rate=1.0, budget_s=0.0,
+                           log=lambda *a: None) as aud:
+            assert not aud.wants("req000")
+            assert aud.sampled == 1 and aud.budget_skipped == 1
+        stats = aud.stats()
+        assert stats["budget_skipped"] == 1 and stats["audited"] == 0
+
+
+# ------------------------------------------------------- metrics + policy
+
+
+class TestMetricsAndPolicy:
+    def test_identical_solves_have_zero_drift(self):
+        from sagecal_tpu.obs.shadow import compute_drift_metrics
+
+        p = np.arange(2 * 1 * 24, dtype=np.float64).reshape(2, 1, 24)
+        m = compute_drift_metrics(p, p.copy(), 0.5, 0.5, 10.0, 10.0)
+        assert m["cost_rel_delta"] == 0.0
+        assert m["gain_rel_err_max"] == 0.0
+        assert m["chi2_rel_delta"] == 0.0
+        assert m["gain_rel_err_station"] == [0.0, 0.0, 0.0]
+
+    def test_per_station_attribution(self):
+        """Perturbing one station's parameter block moves exactly that
+        station's entry (the 8-reals-per-station packing of
+        core.types.jones_to_params)."""
+        from sagecal_tpu.obs.shadow import compute_drift_metrics
+
+        rng = np.random.default_rng(7)
+        p_ref = rng.normal(size=(2, 1, 4 * 8))  # 4 stations
+        p_prod = p_ref.copy()
+        p_prod[..., 2 * 8:3 * 8] += 0.25  # station 2 only
+        m = compute_drift_metrics(p_prod, p_ref, 1.0, 1.0, None, None)
+        sta = m["gain_rel_err_station"]
+        assert len(sta) == 4
+        assert np.argmax(sta) == 2
+        assert sta[0] == sta[1] == sta[3] == 0.0
+        # the station list is rounded for the ledger; the max is exact
+        assert np.isclose(m["gain_rel_err_max"], sta[2])
+        assert sta[2] > 0.0
+        expected = 0.25 / np.abs(
+            p_ref.reshape(2, 1, 4, 8)[:, :, 2, :]).max()
+        assert np.isclose(sta[2], expected)
+        assert "chi2_rel_delta" not in m  # no chi^2 -> no fake zero
+
+    def test_tolerance_table_shape(self):
+        """Policy-table invariants: one row per characterized pair,
+        every row bounds all three ledger metrics, bf16 pairs are
+        strictly looser than their f32 siblings, and unknown pairs get
+        the (loosest) default row."""
+        from sagecal_tpu.obs.drift import DRIFT_METRICS
+        from sagecal_tpu.obs.shadow import (
+            DRIFT_TOLERANCES, lookup_tolerances, path_pair,
+        )
+
+        for pair, tol in DRIFT_TOLERANCES.items():
+            assert set(tol) == set(DRIFT_METRICS), pair
+            assert all(v > 0 for v in tol.values()), pair
+        for kp in ("fused", "fused_batch"):
+            f32 = DRIFT_TOLERANCES[path_pair(kp, "f32")]
+            bf16 = DRIFT_TOLERANCES[path_pair(kp, "bf16")]
+            for m in DRIFT_METRICS:
+                assert bf16[m] > f32[m], (kp, m)
+        assert lookup_tolerances("gpu/tf32|xla/f32") == \
+            DRIFT_TOLERANCES["default"]
+        assert path_pair("fused_batch", "bf16") == \
+            "fused_batch/bf16|xla/f32"
+
+    def test_verdicts(self):
+        from sagecal_tpu.obs.shadow import drift_verdict
+
+        ok, reasons = drift_verdict(
+            {"cost_rel_delta": 1e-6, "gain_rel_err_max": 1e-5,
+             "chi2_rel_delta": 0.0}, "fused/f32|xla/f32")
+        assert ok == "ok" and reasons == []
+        bad, reasons = drift_verdict(
+            {"cost_rel_delta": 1e-6, "gain_rel_err_max": 2e-3},
+            "fused/f32|xla/f32")
+        assert bad == "drift_exceeded"
+        assert any("gain_rel_err_max" in r for r in reasons)
+        nan, reasons = drift_verdict(
+            {"cost_rel_delta": float("nan")}, "xla/f32|xla/f32")
+        assert nan == "drift_exceeded"
+        assert any("non-finite" in r for r in reasons)
+
+
+# ----------------------------------------------------------------- ledger
+
+
+def _row(i=0, verdict="ok", **kw):
+    from sagecal_tpu.obs.shadow import DRIFT_KIND, DRIFT_SCHEMA_VERSION
+
+    row = {
+        "schema_version": DRIFT_SCHEMA_VERSION, "kind": DRIFT_KIND,
+        "ts": 100.0 + i, "request_id": f"req{i:03d}",
+        "path_pair": "xla/f32|xla/f32", "kernel_path": "xla",
+        "kernel_path_reason": "fused predict disabled in config",
+        "bucket": "N7xB42xT2xC1xM2", "coh_dtype": "f32",
+        "solver_dtype": "float64", "cost_rel_delta": 1e-6,
+        "gain_rel_err_max": 2e-6, "chi2_rel_delta": 3e-6,
+        "verdict": verdict, "reasons": [], "shadow_s": 0.1,
+    }
+    row.update(kw)
+    return row
+
+
+class TestLedger:
+    def test_read_skips_corrupt_and_foreign_lines(self, tmp_path):
+        from sagecal_tpu.obs.shadow import read_drift, validate_drift
+
+        path = tmp_path / "drift.jsonl"
+        rows = [_row(0), _row(1)]
+        with open(path, "w") as f:
+            f.write(json.dumps(rows[1]) + "\n")
+            f.write('{"kind": "other_stream", "ts": 1}\n')
+            f.write(json.dumps(rows[0]) + "\n")
+            f.write('{"request_id": "torn tail')  # killed writer
+        got = read_drift(str(path))
+        assert [r["request_id"] for r in got] == ["req000", "req001"]
+        assert validate_drift(got) == []
+
+    def test_validate_catches_structural_problems(self):
+        from sagecal_tpu.obs.shadow import validate_drift
+
+        assert validate_drift([]) == ["no drift records"]
+        bad = _row(0)
+        del bad["bucket"]
+        bad["shadow_s"] = -1.0
+        bad["schema_version"] = 99
+        problems = validate_drift([bad])
+        assert any("missing key bucket" in p for p in problems)
+        assert any("shadow_s" in p for p in problems)
+        assert any("schema_version 99" in p for p in problems)
+
+    def test_validate_catches_policy_inconsistent_verdict(self):
+        """A record claiming "ok" while its own metrics exceed the
+        tolerance row for its path pair is invalid — the ledger cannot
+        drift from the policy table it quotes."""
+        from sagecal_tpu.obs.shadow import validate_drift
+
+        lying = _row(0, gain_rel_err_max=0.4)  # >> 5e-4, says "ok"
+        problems = validate_drift([lying])
+        assert any("disagrees with the tolerance policy" in p
+                   for p in problems)
+        honest = _row(1, gain_rel_err_max=0.4, verdict="drift_exceeded")
+        assert validate_drift([honest]) == []
+
+
+# ------------------------------------------------------------ aggregation
+
+
+class TestAggregation:
+    def test_quantile_bounds_contain_exact_max(self):
+        """The provable-interval discipline: for every group/metric the
+        p99 bound interval contains the exact observed maximum (the
+        histogram clamps against observed extremes)."""
+        from sagecal_tpu.obs.drift import (
+            DRIFT_METRICS, aggregate_drift, drift_quantiles,
+        )
+
+        rng = np.random.default_rng(3)
+        rows = [_row(i, cost_rel_delta=float(10 ** rng.uniform(-8, -3)),
+                     gain_rel_err_max=float(10 ** rng.uniform(-7, -4)),
+                     chi2_rel_delta=float(10 ** rng.uniform(-9, -5)))
+                for i in range(40)]
+        groups = aggregate_drift(rows)
+        assert len(groups) == 1
+        quant = drift_quantiles(groups)
+        for key, g in groups.items():
+            assert g["n"] == 40
+            for m in DRIFT_METRICS:
+                exact_max = max(float(r[m]) for r in rows)
+                lo, hi = quant[key][m]["p99"]
+                assert lo <= exact_max <= hi, (m, lo, exact_max, hi)
+
+    def test_groups_split_by_pair_bucket_dtype(self):
+        from sagecal_tpu.obs.drift import aggregate_drift
+
+        rows = [_row(0), _row(1, bucket="N8xB56xT2xC1xM2"),
+                _row(2, path_pair="fused/bf16|xla/f32",
+                     coh_dtype="bf16"),
+                _row(3, verdict="drift_exceeded",
+                     gain_rel_err_max=0.4)]
+        groups = aggregate_drift(rows)
+        assert len(groups) == 3
+        key = ("xla/f32|xla/f32", "N7xB42xT2xC1xM2", "float64")
+        assert groups[key]["n"] == 2 and groups[key]["exceeded"] == 1
+
+    def test_report_paths(self):
+        from sagecal_tpu.obs.drift import (
+            analyze_drift, format_drift_report,
+        )
+
+        empty = analyze_drift([])
+        lines = format_drift_report(empty)
+        assert any("no samples" in ln for ln in lines)
+        rep = analyze_drift([_row(0), _row(
+            1, verdict="drift_exceeded", gain_rel_err_max=0.4,
+            reasons=["gain_rel_err_max 4.000e-01 exceeds ..."])])
+        assert rep["n_exceeded"] == 1
+        lines = format_drift_report(rep)
+        assert any("BREACH req001" in ln for ln in lines)
+        assert any("tol=" in ln for ln in lines)
+
+
+# ------------------------------------------------------------- live serve
+
+
+def _serve(tmp_path, tag, n=4, shadow_rate=None, elog=None, **cfg_kw):
+    from sagecal_tpu.apps.config import ServeConfig
+    from sagecal_tpu.serve.request import load_requests
+    from sagecal_tpu.serve.service import CalibrationService
+    from sagecal_tpu.serve.synthetic import make_synthetic_workload
+
+    manifest = make_synthetic_workload(
+        str(tmp_path / f"w-{tag}"), n, n_tenants=1,
+        shapes=((7, 4, 2),))
+    reqs = load_requests(manifest)
+    out = tmp_path / f"out-{tag}"
+    kw = dict(out_dir=str(out), batch=2, **cfg_kw)
+    if shadow_rate is not None:
+        kw["shadow_rate"] = shadow_rate
+    cfg = ServeConfig(**kw)
+    summary = CalibrationService(cfg, log=lambda *a: None).run(
+        reqs, elog=elog)
+    return out, summary
+
+
+def _solutions(out_dir):
+    """request_id -> (raw solutions-file bytes, res_1) per manifest."""
+    sols = {}
+    for name in sorted(os.listdir(out_dir)):
+        if name.endswith(".result.json"):
+            with open(os.path.join(out_dir, name)) as f:
+                doc = json.load(f)
+            with open(doc["solutions"], "rb") as f:
+                blob = f.read()
+            sols[doc["request_id"]] = (blob, doc.get("res_1"))
+    return sols
+
+
+class TestLiveServe:
+    def test_shadowed_run_ledger_and_diag(self, tmp_path):
+        """serve at --shadow-rate 1.0: one valid drift record per
+        request, kernel_path surfaced in every manifest, quantile
+        bounds containing the exact sampled max, diag drift exit 0."""
+        from sagecal_tpu.obs.diag import main as diag_main
+        from sagecal_tpu.obs.drift import (
+            DRIFT_METRICS, aggregate_drift, drift_quantiles,
+        )
+        from sagecal_tpu.obs.shadow import (
+            drift_path, read_drift, validate_drift,
+        )
+
+        elog = _FakeLog()
+        out, summary = _serve(tmp_path, "shadowed", n=4,
+                              shadow_rate=1.0, elog=elog)
+        assert summary["served"] == 4
+        assert summary["shadow"]["audited"] == 4
+        assert summary["shadow"]["exceeded"] == []
+
+        rows = read_drift(drift_path(str(out)))
+        assert len(rows) == 4
+        assert validate_drift(rows) == []
+        assert all(r["verdict"] == "ok" for r in rows)
+
+        # satellite: every result manifest names its kernel path
+        for name in os.listdir(out):
+            if name.endswith(".result.json"):
+                with open(os.path.join(out, name)) as f:
+                    doc = json.load(f)
+                assert doc["kernel_path"] in (
+                    "xla", "fused", "fused_batch")
+                assert isinstance(doc["kernel_path_reason"], str)
+
+        # the audit hook fed the event stream
+        checks = [e for e in elog.events
+                  if e["kind"] == "shadow_drift_check"]
+        assert len(checks) == 4
+        assert all(e["verdict"] == "ok" for e in checks)
+
+        # acceptance: provable p99 bounds contain the exact max
+        groups = aggregate_drift(rows)
+        quant = drift_quantiles(groups)
+        checked = 0
+        for key, g in groups.items():
+            for m in DRIFT_METRICS:
+                if g["max"][m] is None:
+                    continue
+                lo, hi = quant[key][m]["p99"]
+                assert lo <= g["max"][m] <= hi
+                checked += 1
+        assert checked > 0
+
+        assert diag_main(["drift", str(out)]) == 0
+        # reading the ledger file directly works too
+        assert diag_main(["drift", str(drift_path(str(out)))]) == 0
+
+    def test_injected_drift_is_caught(self, tmp_path, monkeypatch):
+        """The seeded injected-drift fixture: perturbing the reference
+        solution must surface as drift_exceeded records, a watchdog
+        event, and diag drift exit 1."""
+        from sagecal_tpu.obs.diag import main as diag_main
+        from sagecal_tpu.obs.shadow import (
+            INJECT_DRIFT_ENV, drift_path, read_drift, validate_drift,
+        )
+
+        monkeypatch.setenv(INJECT_DRIFT_ENV, "0.05")
+        elog = _FakeLog()
+        out, summary = _serve(tmp_path, "inject", n=2,
+                              shadow_rate=1.0, elog=elog)
+        assert summary["shadow"]["audited"] == 2
+        assert len(summary["shadow"]["exceeded"]) == 2
+        rows = read_drift(drift_path(str(out)))
+        assert validate_drift(rows) == []
+        assert all(r["verdict"] == "drift_exceeded" for r in rows)
+        assert [e for e in elog.events if e["kind"] == "drift_exceeded"]
+        assert diag_main(["drift", str(out)]) == 1
+
+    def test_shadow_rate_zero_is_off_path(self, tmp_path):
+        """Acceptance: --shadow-rate 0 (the default) leaves zero trace
+        — no auditor, no ledger — and its solutions are byte-equal to
+        a fully shadowed run of the same workload (the audit reads
+        shipped results, never perturbs them)."""
+        from sagecal_tpu.obs.diag import main as diag_main
+        from sagecal_tpu.obs.shadow import DRIFT_FILE
+
+        out_off, s_off = _serve(tmp_path, "off", n=3)  # default cfg
+        out_zero, s_zero = _serve(tmp_path, "zero", n=3,
+                                  shadow_rate=0.0)
+        out_on, s_on = _serve(tmp_path, "on", n=3, shadow_rate=1.0)
+        assert "shadow" not in s_off and "shadow" not in s_zero
+        assert not (out_off / DRIFT_FILE).exists()
+        assert not (out_zero / DRIFT_FILE).exists()
+        assert (out_on / DRIFT_FILE).exists()
+
+        sols_off = _solutions(out_off)
+        assert len(sols_off) == 3
+        assert sols_off == _solutions(out_zero)
+        assert sols_off == _solutions(out_on)
+
+        # an un-shadowed out-dir is a warning, not a failure
+        assert diag_main(["drift", str(out_zero)]) == 0

@@ -374,6 +374,35 @@ class TestTimeline:
         assert row["results_total"] == 1 and row["shed_total"] == 1
         assert row.get("slo_burn_max_short", 0.0) == 0.0
 
+    def test_torn_manifest_read_ingests_exactly_once(self, tmp_path):
+        """A manifest caught mid-write (invalid JSON) is forgotten and
+        retried; once the (atomic-rename) final file lands, its verdict
+        is counted exactly once — never zero, never double."""
+        from sagecal_tpu.obs.timeline import TimelineSampler
+
+        out = tmp_path / "out"
+        out.mkdir()
+        torn = out / "r1.result.json"
+        torn.write_text('{"request_id": "r1", "verd')  # torn mid-write
+        with TimelineSampler(str(out / "timeline.jsonl"),
+                             out_dir=str(out)) as s:
+            row = s.sample(now=100.0)
+            # the torn file parses as nothing and must not be counted
+            assert row.get("results_total", 0) == 0
+            # writer completes via the atomic-rename protocol
+            tmp = out / ".r1.result.json.tmp"
+            tmp.write_text(json.dumps(
+                {"request_id": "r1", "tenant": "t0", "verdict": "ok",
+                 "completed_at": 100.5, "latency_s": 0.5}))
+            os.replace(str(tmp), str(torn))
+            row = s.sample(now=101.0)
+            assert row["results_total"] == 1
+            assert s._verdicts == {"ok": 1}
+            # further samples must not re-ingest the same manifest
+            row = s.sample(now=102.0)
+            assert row["results_total"] == 1
+            assert s._verdicts == {"ok": 1}
+
     def test_validate_flags_broken_timelines(self):
         from sagecal_tpu.obs.timeline import validate_timeline
 

@@ -154,10 +154,10 @@ if probe; then
 fi
 echo "=== bf16-coherency fused bench"
 if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
-echo "=== telemetry+quality+trace+serve_obs+fleet+stream+sky+protocol+devprof+load test pass (CPU, marker-driven)"
+echo "=== telemetry+quality+trace+serve_obs+fleet+stream+sky+protocol+devprof+load+drift test pass (CPU, marker-driven)"
 JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 1200 \
   python -m pytest tests/ -q \
-  -m "telemetry or quality or trace or serve_obs or fleet or stream or sky or protocol or devprof or load" \
+  -m "telemetry or quality or trace or serve_obs or fleet or stream or sky or protocol or devprof or load or drift" \
   -p no:cacheprovider | tail -3
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then echo "telemetry test pass FAILED rc=$rc"; exit 1; fi
@@ -296,6 +296,44 @@ JAX_PLATFORMS=cpu timeout 120 python -m sagecal_tpu.obs.diag serve \
   "$SRVDIR" --spans "$SRVDIR/spans.jsonl" \
   || { echo "diag serve FAILED on a healthy run"; exit 1; }
 rm -rf "$SRVDIR"
+echo "=== shadow-drift smoke (CPU, every request audited vs xla/f32)"
+# numerical-truth path end to end: serve with --shadow-rate 1.0, every
+# request re-solved on the reference path after its manifest lands; the
+# drift ledger must validate and a clean run must gate exit 0
+SHDIR=$(mktemp -d)
+JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 SAGECAL_WORKER_ID=smoke \
+  timeout 560 python -m sagecal_tpu.apps.cli serve \
+  --synthetic 6 --tenants 2 --batch 2 --out-dir "$SHDIR" \
+  --shadow-rate 1.0 \
+  || { echo "shadow-drift serve smoke FAILED rc=$?"; exit 1; }
+JAX_PLATFORMS=cpu timeout 60 python - "$SHDIR" <<'PY'
+import sys
+from sagecal_tpu.obs.shadow import drift_path, read_drift, validate_drift
+rows = read_drift(drift_path(sys.argv[1]))
+assert len(rows) == 6, f"expected 6 drift records, got {len(rows)}"
+problems = validate_drift(rows)
+assert problems == [], problems
+assert all(r["verdict"] == "ok" for r in rows), rows
+print("shadow-drift smoke ok:", len(rows), "audits,",
+      sorted({r["path_pair"] for r in rows}))
+PY
+[ $? = 0 ] || { echo "shadow-drift validate FAILED"; exit 1; }
+JAX_PLATFORMS=cpu timeout 120 python -m sagecal_tpu.obs.diag drift "$SHDIR" \
+  || { echo "diag drift FAILED on a clean run"; exit 1; }
+rm -rf "$SHDIR"
+echo "=== injected-drift fixture (diag drift must catch it, exit 1)"
+# seeded perturbation of the REFERENCE solutions: a real disagreement
+# must reach diag drift as a nonzero exit (the detector detecting)
+SHDIR=$(mktemp -d)
+JAX_PLATFORMS=cpu SAGECAL_SHADOW_INJECT_DRIFT=0.05 \
+  timeout 560 python -m sagecal_tpu.apps.cli serve \
+  --synthetic 4 --tenants 2 --batch 2 --out-dir "$SHDIR" \
+  --shadow-rate 1.0 \
+  || { echo "injected-drift serve FAILED rc=$?"; exit 1; }
+if JAX_PLATFORMS=cpu timeout 120 python -m sagecal_tpu.obs.diag drift "$SHDIR"
+then echo "diag drift MISSED injected drift - stop"; exit 1
+fi
+rm -rf "$SHDIR"
 echo "=== refine smoke (CPU, bilevel flux recovery)"
 # sky-model refinement end to end: 3 outer LBFGS steps over a
 # 15%-perturbed source flux, through the inner gain solve, must come
