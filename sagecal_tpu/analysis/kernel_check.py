@@ -1,0 +1,398 @@
+"""Kernel contract checker: commit-time proofs of the Pallas grids'
+hardware invariants.
+
+The VMEM footprint model (:mod:`sagecal_tpu.analysis.kernelmodel`)
+turns ``ops/rime_kernel.py``'s BlockSpecs and scratch census into a
+closed-form per-grid-step residency.  This module runs the full
+contract suite over it and over the kernel-aware lint rules, producing
+a machine-readable violation list with stable *kinds*:
+
+======================  =================================================
+kind                    meaning
+======================  =================================================
+``model-extraction``    the symbolic interpreter could not extract a
+                        grid from the kernel source (structural drift —
+                        the model must be taught the new idiom before
+                        any VMEM claim can be trusted)
+``vmem-ceiling``        a shipped operating point's modeled footprint
+                        exceeds the backend's scoped-VMEM ceiling
+``tile-bound``          ``FULL_CLUSTER_TILE`` exceeds the largest tile
+                        the model proves feasible for every
+                        differentiated kernel family
+``batch-rows-bound``    ``_BATCH_ROWS_MAX`` (solvers/batched.py)
+                        exceeds the model's proven-envelope row bound
+``grid-coverage``       a grid's index sequence does not tile an
+                        operand exactly (rank mismatch, uncovered
+                        padded extent)
+``table-stale``         ``KERNEL_VMEM_TABLE.json`` no longer matches
+                        the model (regenerate with
+                        ``tools/kernel_vmem_table.py``)
+``crosscheck``          model HBM accounting disagrees with a compiled
+                        ``memory_analysis()`` beyond tolerance
+``JL013``/``JL014``/\
+``JL015``               a kernel-aware lint finding (cotangent
+                        completeness / precision flow / BlockSpec
+                        hazards)
+======================  =================================================
+
+Exit codes (CLI / ``diag kernelcheck``): 0 all contracts hold, 1 at
+least one violation, 2 internal/usage error.
+
+``run_kernel_check`` accepts path overrides for the kernel and batched
+sources so the seeded-mutation tests (tests/test_kernelmodel.py) can
+prove each contract actually *fires* without touching the real tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from sagecal_tpu.analysis import kernelmodel as km
+from sagecal_tpu.analysis.kernelmodel import (
+    CEILINGS, DEFAULT_BACKEND, DIFFERENTIATED_FAMILIES, FAMILIES,
+    KernelConfig, ModelExtractionError, NORTH_STAR,
+    PROVEN_BATCH_ENVELOPE, default_kernel_path, load_model)
+
+# model-vs-compiled HBM accounting tolerance for --crosscheck: the
+# model counts exact operand/output bytes; XLA may pad small buffers
+CROSSCHECK_RTOL = 0.02
+
+# forward families whose impls lower cleanly on CPU interpret mode —
+# the --crosscheck sample set.  The bool is check_outputs: the cost
+# impls reduce the grid output to a scalar AFTER the pallas call, so
+# only their operand accounting is comparable against the compiled
+# program; predict returns the grid output unreduced.
+CROSSCHECK_CONFIGS = (
+    ("predict_fwd", dict(Mp=8, F=2, tile=128, rowsp=256), True),
+    ("cost_fwd", dict(Mp=8, F=2, tile=128, rowsp=256), False),
+    ("cost_batch_fwd", dict(Mp=8, B=2, F=2, tile=128, rowsp=256), False),
+)
+
+
+def default_batched_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "solvers", "batched.py")
+
+
+def default_table_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "KERNEL_VMEM_TABLE.json")
+
+
+def shipped_batch_rows_max(batched_path: str) -> Optional[int]:
+    """The ``_BATCH_ROWS_MAX`` constant as shipped (AST, no import —
+    the checker must see the mutated source, not the loaded module)."""
+    import ast
+    try:
+        with open(batched_path, "r") as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_BATCH_ROWS_MAX"
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            return node.value.value
+    return None
+
+
+def _violation(kind: str, message: str, **detail: Any) -> Dict[str, Any]:
+    v: Dict[str, Any] = {"kind": kind, "message": message}
+    if detail:
+        v["detail"] = detail
+    return v
+
+
+def _check_model_contracts(model: km.KernelModel, backend: str,
+                           batched_path: str) -> List[Dict[str, Any]]:
+    violations: List[Dict[str, Any]] = []
+    ceiling = CEILINGS[backend]
+    shipped_tile = int(model.consts.get("FULL_CLUSTER_TILE", 0))
+    derived_tile = model.derived_full_cluster_tile(backend)
+    if shipped_tile > derived_tile:
+        violations.append(_violation(
+            "tile-bound",
+            "FULL_CLUSTER_TILE=%d exceeds the largest tile (%d) the "
+            "VMEM model proves feasible for all differentiated kernel "
+            "families on %s" % (shipped_tile, derived_tile, backend),
+            shipped=shipped_tile, derived=derived_tile))
+    # shipped operating points must fit the ceiling outright
+    for fam in DIFFERENTIATED_FAMILIES:
+        cfg = KernelConfig(Mp=NORTH_STAR["Mp"], F=NORTH_STAR["F"],
+                           tile=shipped_tile or 128)
+        fp = model.footprint(fam, cfg)
+        if fp.total_bytes > ceiling:
+            violations.append(_violation(
+                "vmem-ceiling",
+                "%s at FULL_CLUSTER_TILE=%d, Mp=%d needs %.2f MiB > "
+                "%.0f MiB ceiling (%s)" % (
+                    fam, cfg.tile, cfg.Mp, fp.mib,
+                    ceiling / (1024.0 * 1024.0), backend),
+                family=fam, bytes=fp.total_bytes, ceiling=ceiling))
+    shipped_rows = shipped_batch_rows_max(batched_path)
+    if shipped_rows is not None:
+        env_tile = int(PROVEN_BATCH_ENVELOPE["tile"])
+        model_rows = model.batch_rows_max(env_tile, "f32", backend)
+        if shipped_rows > model_rows:
+            violations.append(_violation(
+                "batch-rows-bound",
+                "_BATCH_ROWS_MAX=%d exceeds the model's proven-"
+                "envelope bound of %d rows (f32, tile %d, %s)" % (
+                    shipped_rows, model_rows, env_tile, backend),
+                shipped=shipped_rows, model=model_rows))
+        env_cfg = KernelConfig(
+            Mp=8, B=max(1, shipped_rows // 8), F=NORTH_STAR["F"],
+            tile=env_tile)
+        fp = model.footprint("cost_batch_bwd", env_cfg)
+        if fp.total_bytes > ceiling:
+            violations.append(_violation(
+                "vmem-ceiling",
+                "batched backward at _BATCH_ROWS_MAX=%d rows needs "
+                "%.2f MiB > %.0f MiB ceiling (%s)" % (
+                    shipped_rows, fp.mib,
+                    ceiling / (1024.0 * 1024.0), backend),
+                family="cost_batch_bwd", bytes=fp.total_bytes,
+                ceiling=ceiling))
+    for fam in FAMILIES:
+        if fam.startswith("cost_batch"):
+            cfg = KernelConfig(Mp=8, B=2, F=NORTH_STAR["F"],
+                               tile=shipped_tile or 128)
+        else:
+            cfg = KernelConfig(Mp=NORTH_STAR["Mp"], F=NORTH_STAR["F"],
+                               tile=shipped_tile or 128)
+        try:
+            for problem in model.coverage_problems(fam, cfg):
+                violations.append(_violation("grid-coverage", problem,
+                                             family=fam))
+        except ModelExtractionError as exc:
+            violations.append(_violation(
+                "model-extraction",
+                "%s: %s" % (fam, exc), family=fam))
+    return violations
+
+
+def _check_table(model: km.KernelModel, table_path: str,
+                 backend: str) -> List[Dict[str, Any]]:
+    if not os.path.exists(table_path):
+        return [_violation(
+            "table-stale",
+            "%s missing — generate it with tools/kernel_vmem_table.py"
+            % table_path)]
+    try:
+        with open(table_path, "r") as fh:
+            banked = json.load(fh)
+    except (OSError, ValueError) as exc:
+        return [_violation(
+            "table-stale", "%s unreadable: %s" % (table_path, exc))]
+    current = model.build_table(backend)
+    if banked != current:
+        drifted = sorted(
+            k for k in set(banked) | set(current)
+            if banked.get(k) != current.get(k))
+        return [_violation(
+            "table-stale",
+            "%s does not match the model (drifted keys: %s) — "
+            "regenerate with tools/kernel_vmem_table.py" % (
+                table_path, ", ".join(drifted)),
+            drifted=drifted)]
+    return []
+
+
+def _check_lint(kernel_path: Optional[str]) -> List[Dict[str, Any]]:
+    from sagecal_tpu.analysis.engine import analyze_paths
+    from sagecal_tpu.analysis.rules.jl013 import CotangentCompleteness
+    from sagecal_tpu.analysis.rules.jl014 import PrecisionFlow
+    from sagecal_tpu.analysis.rules.jl015 import BlockSpecHazard
+    if kernel_path is None:
+        paths = [os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))]  # the whole package
+    else:
+        # mutation-sandbox mode: the kernel under test plus the bf16
+        # ingestion context (solvers/sage.py) JL014 taints from
+        paths = [kernel_path]
+        sage = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "solvers", "sage.py")
+        if os.path.exists(sage):
+            paths.append(sage)
+    findings, _stats, _graph = analyze_paths(
+        paths, rules=[CotangentCompleteness(), PrecisionFlow(),
+                      BlockSpecHazard()])
+    out = []
+    for f in findings:
+        if f.report_only:
+            continue
+        out.append(_violation(
+            f.rule, "%s:%d: %s" % (f.path, f.line, f.message),
+            symbol=f.symbol))
+    return out
+
+
+def _check_crosscheck(model: km.KernelModel) -> List[Dict[str, Any]]:
+    """Model HBM accounting vs jax compiled memory_analysis() on CPU
+    lowerings of the forward impls (lazy jax import)."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+
+    from sagecal_tpu.ops import rime_kernel
+
+    np_dtype = {"f32": jnp.float32, "bf16": jnp.bfloat16,
+                "i32": jnp.int32, "f64": jnp.float64}
+    violations: List[Dict[str, Any]] = []
+    for fam, cfg_kw, check_outputs in CROSSCHECK_CONFIGS:
+        cfg = KernelConfig(**cfg_kw)
+        tensors, kwargs = model._operands(fam, cfg)
+        fn = getattr(rime_kernel, km.IMPLS[fam])
+        args = [jax.ShapeDtypeStruct(t.shape, np_dtype[t.dtype])
+                for t in tensors]
+        compiled = jax.jit(
+            functools.partial(fn, **kwargs)).lower(*args).compile()
+        mem = compiled.memory_analysis()
+        pairs = [
+            ("operands", model.hbm_operand_bytes(fam, cfg),
+             getattr(mem, "argument_size_in_bytes", None)),
+        ]
+        if check_outputs:
+            pairs.append(
+                ("outputs", model.hbm_output_bytes(fam, cfg),
+                 getattr(mem, "output_size_in_bytes", None)))
+        for what, predicted, measured in pairs:
+            if measured is None:
+                continue  # backend without memory_analysis fields
+            rel = (abs(predicted - measured)
+                   / max(1.0, float(measured)))
+            if rel > CROSSCHECK_RTOL:
+                violations.append(_violation(
+                    "crosscheck",
+                    "%s %s: model %d bytes vs compiled %d bytes "
+                    "(rel %.4f > %.2f)" % (
+                        fam, what, predicted, measured, rel,
+                        CROSSCHECK_RTOL),
+                    family=fam, predicted=predicted,
+                    measured=int(measured)))
+    return violations
+
+
+def run_kernel_check(kernel_path: Optional[str] = None,
+                     batched_path: Optional[str] = None,
+                     table_path: Optional[str] = None,
+                     backend: str = DEFAULT_BACKEND,
+                     check_table: bool = True,
+                     lint: bool = True,
+                     crosscheck: bool = False) -> Dict[str, Any]:
+    """Run every kernel contract; returns ``{"violations": [...],
+    "summary": {...}}``.  Path overrides exist for the seeded-mutation
+    tests; production callers use the defaults."""
+    resolved_kernel = kernel_path or default_kernel_path()
+    resolved_batched = batched_path or default_batched_path()
+    resolved_table = table_path or default_table_path()
+    violations: List[Dict[str, Any]] = []
+    model: Optional[km.KernelModel] = None
+    try:
+        model = load_model(path=resolved_kernel)
+    except (ModelExtractionError, OSError, SyntaxError) as exc:
+        violations.append(_violation(
+            "model-extraction",
+            "cannot extract the VMEM model from %s: %s" % (
+                resolved_kernel, exc)))
+    if model is not None:
+        try:
+            violations.extend(_check_model_contracts(
+                model, backend, resolved_batched))
+        except ModelExtractionError as exc:
+            violations.append(_violation(
+                "model-extraction", str(exc)))
+        if check_table:
+            violations.extend(_check_table(
+                model, resolved_table, backend))
+        if crosscheck:
+            violations.extend(_check_crosscheck(model))
+    if lint:
+        violations.extend(_check_lint(kernel_path))
+    summary: Dict[str, Any] = {
+        "backend": backend,
+        "kernel": resolved_kernel,
+        "violations": len(violations),
+        "kinds": sorted({v["kind"] for v in violations}),
+    }
+    if model is not None:
+        summary["full_cluster_tile"] = {
+            "shipped": int(model.consts.get("FULL_CLUSTER_TILE", 0)),
+            "derived": model.derived_full_cluster_tile(backend),
+        }
+        summary["batch_rows_max"] = {
+            "shipped": shipped_batch_rows_max(resolved_batched),
+            "f32": model.batch_rows_max(None, "f32", backend),
+            "bf16": model.batch_rows_max(None, "bf16", backend),
+        }
+    return {"violations": violations, "summary": summary}
+
+
+def render_text(result: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    s = result["summary"]
+    lines.append("kernelcheck: backend=%s kernel=%s" % (
+        s["backend"], s["kernel"]))
+    if "full_cluster_tile" in s:
+        lines.append(
+            "  FULL_CLUSTER_TILE shipped=%(shipped)d derived=%(derived)d"
+            % s["full_cluster_tile"])
+    if "batch_rows_max" in s:
+        lines.append(
+            "  batch rows shipped=%(shipped)s model f32=%(f32)d "
+            "bf16=%(bf16)d" % s["batch_rows_max"])
+    if not result["violations"]:
+        lines.append("  OK — all kernel contracts hold")
+    for v in result["violations"]:
+        lines.append("  VIOLATION [%s] %s" % (v["kind"], v["message"]))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kernelcheck",
+        description="Static VMEM-budget and kernel-contract checker")
+    parser.add_argument("--json", action="store_true",
+                        help="emit machine-readable JSON")
+    parser.add_argument("--backend", default=DEFAULT_BACKEND,
+                        choices=sorted(CEILINGS),
+                        help="ceiling table entry to prove against")
+    parser.add_argument("--kernel", default=None,
+                        help="kernel source override (mutation tests)")
+    parser.add_argument("--batched", default=None,
+                        help="batched-solver source override")
+    parser.add_argument("--table", default=None,
+                        help="VMEM table artifact path")
+    parser.add_argument("--no-table-check", action="store_true",
+                        help="skip the table staleness gate")
+    parser.add_argument("--crosscheck", action="store_true",
+                        help="also cross-check HBM accounting against "
+                             "a compiled memory_analysis() (needs jax)")
+    args = parser.parse_args(argv)
+    try:
+        result = run_kernel_check(
+            kernel_path=args.kernel, batched_path=args.batched,
+            table_path=args.table, backend=args.backend,
+            check_table=not args.no_table_check,
+            crosscheck=args.crosscheck)
+    except Exception as exc:  # internal error, not a violation
+        print("kernelcheck: internal error: %s" % exc, file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(result, indent=2, sort_keys=True))
+    else:
+        print(render_text(result))
+    return 1 if result["violations"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1158 @@
+"""Symbolic VMEM-footprint model of the fused Pallas RIME grids.
+
+The fused kernels in ``sagecal_tpu/ops/rime_kernel.py`` live or die by
+a 16 MB scoped-VMEM ceiling that today is encoded only in hand-tuned
+constants (``FULL_CLUSTER_TILE``, ``solvers/batched.py``'s
+``_BATCH_ROWS_MAX``) and a comment block of round-5 hardware findings.
+This module turns that comment into a checkable model: it parses the
+REAL kernel source with the stdlib AST (no jax import — the model must
+run in lint/CI context), symbolically executes the ``*_impl`` grid
+builders to recover every ``pl.BlockSpec`` block shape, index map,
+memory space and operand dtype, counts the kernel bodies' scoped
+scratch planes, and prices the per-grid-step VMEM residency of any
+``(tile, Mp, B, nc, coh_dtype)`` configuration.
+
+Footprint decomposition (per grid step)::
+
+    total = sum(block_bytes x buffering)          # BlockSpec operands
+          + onehot_planes x NPAD x T x 4          # _onehots scratch
+          + lane_planes x B x T x 4               # batch (B, T) planes
+          + factor x census x rows x T x 4        # (rows, T) scratch
+
+``buffering`` is 2 for streamed operands (index_map depends on the
+grid parameter — Mosaic double-buffers the HBM copy) and 1 for
+grid-invariant / revisited blocks.  ``census`` counts the kernel
+body's live (rows, T) f32 planes, extracted from the helper functions
+with loop-multiplier-aware AST counting so a source edit (dropping an
+accumulator, adding a plane) moves the model.  ``factor`` is a
+per-direction calibration ratio fitted as ``max(1, observed/raw)``
+over the round-5 hardware anchors recorded in the kernel source's
+VMEM comment — the model is exact on block arithmetic and
+conservatively calibrated on Mosaic's scratch accounting.
+
+Derived contracts:
+
+- ``derived_full_cluster_tile()`` — the largest sweep tile whose
+  forward AND backward footprints fit the backend ceiling at the
+  north-star cluster count; must equal ``FULL_CLUSTER_TILE``.
+- ``batch_rows_max(tile, coh_dtype)`` — the proven-envelope row bound
+  for the batched objective: the largest ``rows = B*Mp`` (multiple of
+  8) whose calibrated batched-backward footprint stays within the
+  footprint of the hardware-proven (rows=104, tile=128, f32) point
+  (never above the ceiling).  The f32 bound at tile 128 reproduces
+  today's ``_BATCH_ROWS_MAX = 104`` exactly by construction; bf16
+  coherencies legitimately admit more rows.
+- ``build_table()`` — the ``KERNEL_VMEM_TABLE.json`` artifact that
+  ``solvers.batched.choose_batched_path`` and future autotuners read
+  instead of hardcoded constants.
+
+Everything here is deterministic: same source bytes -> same table.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+MODEL_VERSION = "1"
+
+MIB = 1024 * 1024
+
+#: Per-backend scoped-VMEM ceiling in bytes — the seam a GPU lowering
+#: (ROADMAP item 5) extends with shared-memory budgets.
+CEILINGS: Dict[str, int] = {"tpu-v5e": 16 * MIB}
+DEFAULT_BACKEND = "tpu-v5e"
+
+#: North-star problem size (ROADMAP: full cluster count, two channels).
+NORTH_STAR: Dict[str, int] = {"Mp": 104, "F": 2}
+
+SWEEP_TILES: Tuple[int, ...] = (64, 128, 256, 512)
+
+FAMILIES: Tuple[str, ...] = (
+    "predict_fwd", "predict_bwd", "cost_fwd", "cost_bwd",
+    "cost_batch_fwd", "cost_batch_bwd",
+)
+#: Families whose bounds define FULL_CLUSTER_TILE (the solo
+#: differentiated paths; the batched grid has its own rows bound).
+DIFFERENTIATED_FAMILIES: Tuple[str, ...] = (
+    "predict_fwd", "predict_bwd", "cost_fwd", "cost_bwd",
+)
+
+#: Round-5 v5e hardware measurements recorded in rime_kernel.py's VMEM
+#: comment block.  ``observed_bytes`` is Mosaic's reported scoped-vmem
+#: request for the grid; ``fits`` whether it compiled under the 16 MB
+#: ceiling.  These anchor the per-direction calibration factors.
+HARDWARE_ANCHORS: Tuple[Dict[str, Any], ...] = (
+    {"family": "predict_fwd", "Mp": 104, "F": 2, "tile": 512,
+     "observed_bytes": int(20.9 * MIB), "fits": False},
+    {"family": "predict_fwd", "Mp": 104, "F": 2, "tile": 256,
+     "observed_bytes": int(10.5 * MIB), "fits": True},
+    {"family": "predict_bwd", "Mp": 104, "F": 2, "tile": 256,
+     "observed_bytes": int(19.7 * MIB), "fits": False},
+)
+
+#: The hardware-proven batched-backward operating point (PR-14 bench:
+#: B=13 lanes of Mp=8 at tile 128, f32 coherencies).  The batched row
+#: bound is an ENVELOPE around this point: configurations are admitted
+#: only while their calibrated footprint stays within the proven
+#: point's footprint (a pure 16 MB ceiling would admit ~152 rows that
+#: no hardware run has ever validated).
+PROVEN_BATCH_ENVELOPE: Dict[str, Any] = {
+    "rows": 104, "tile": 128, "coh_dtype": "f32",
+}
+
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "i32": 4, "f64": 8,
+                "float32": 4, "bfloat16": 2, "int32": 4, "float64": 8}
+_DTYPE_CANON = {"float32": "f32", "bfloat16": "bf16", "int32": "i32",
+                "float64": "f64"}
+
+#: kernel function -> (family, hybrid-capable)
+KERNEL_FAMILY: Dict[str, str] = {
+    "_fwd_kernel": "predict_fwd",
+    "_fwd_kernel_hybrid": "predict_fwd",
+    "_bwd_kernel": "predict_bwd",
+    "_bwd_kernel_hybrid": "predict_bwd",
+    "_obj_fwd_kernel": "cost_fwd",
+    "_obj_fwd_kernel_hybrid": "cost_fwd",
+    "_obj_bwd_kernel": "cost_bwd",
+    "_obj_bwd_kernel_hybrid": "cost_bwd",
+    "_obj_fwd_kernel_batch": "cost_batch_fwd",
+    "_obj_bwd_kernel_batch": "cost_batch_bwd",
+}
+
+#: family -> impl grid-builder function name
+IMPLS: Dict[str, str] = {
+    "predict_fwd": "_fused_predict_fwd_impl",
+    "predict_bwd": "_fused_predict_bwd_impl",
+    "cost_fwd": "_fused_cost_fwd_impl",
+    "cost_bwd": "_fused_cost_bwd_impl",
+    "cost_batch_fwd": "_fused_cost_batch_fwd_impl",
+    "cost_batch_bwd": "_fused_cost_batch_bwd_impl",
+}
+
+
+class ModelExtractionError(Exception):
+    """The kernel source no longer matches the model's structural
+    assumptions (a helper disappeared, a shape contract failed, an
+    impl builder uses an unsupported construct).  Surfaced by the
+    checker as a ``model-extraction`` violation — the model must be
+    updated WITH the kernel, never silently skipped."""
+
+
+# --------------------------------------------------------------- values
+
+
+@dataclass(frozen=True)
+class Tensor:
+    """A symbolic array: shape is concrete ints, dtype a short name."""
+    name: str
+    shape: Tuple[int, ...]
+    dtype: str
+
+    @property
+    def nbytes(self) -> int:
+        return _prod(self.shape) * _DTYPE_BYTES[self.dtype]
+
+
+class _Opaque:
+    """Value the interpreter cannot (and need not) reason about."""
+
+    __slots__ = ("why",)
+
+    def __init__(self, why: str = "") -> None:
+        self.why = why
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<opaque {self.why}>"
+
+
+class _Dotted(str):
+    """A dotted external name (``jax.numpy.float32``) as a value."""
+
+
+@dataclass
+class FuncRef:
+    name: str
+    node: ast.FunctionDef
+
+
+@dataclass
+class PartialFn:
+    ref: FuncRef
+    kwargs: Dict[str, Any]
+
+
+@dataclass
+class LambdaVal:
+    node: ast.Lambda
+    env: Dict[str, Any]
+    interp: "_Interp"
+
+    def __call__(self, *vals: Any) -> Any:
+        params = [a.arg for a in self.node.args.args]
+        if len(vals) != len(params):
+            raise ModelExtractionError(
+                f"index_map lambda at line {self.node.lineno} takes "
+                f"{len(params)} args, called with {len(vals)}")
+        env = dict(self.env)
+        env.update(zip(params, vals))
+        return self.interp._eval(self.node.body, env)
+
+
+@dataclass
+class SpecInstance:
+    """One evaluated ``pl.BlockSpec``."""
+    block_shape: Tuple[int, ...]
+    index_map: Optional[LambdaVal]
+    memory_space: str
+    line: int
+
+    def streamed(self) -> bool:
+        """Whether the block revisits a different operand window per
+        grid step (Mosaic double-buffers these)."""
+        if self.index_map is None:
+            return True  # conservative
+        return tuple(self.index_map(0)) != tuple(self.index_map(1))
+
+
+@dataclass
+class PallasCallObj:
+    kernel: Any
+    grid: Tuple[int, ...]
+    in_specs: Any
+    out_specs: Any
+    out_shape: Any
+    line: int
+
+
+@dataclass
+class GridRecord:
+    """One recorded ``pl.pallas_call`` application."""
+    kernel_name: str
+    kernel_kwargs: Dict[str, Any]
+    grid: Tuple[int, ...]
+    in_specs: List[Tuple[SpecInstance, Tensor]]
+    out_specs: List[Tuple[SpecInstance, Tensor]]
+    line: int
+
+    @property
+    def family(self) -> str:
+        return KERNEL_FAMILY[self.kernel_name]
+
+
+def _prod(shape: Sequence[int]) -> int:
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def _dtype_name(v: Any) -> str:
+    if isinstance(v, Tensor):
+        return v.dtype
+    s = str(v).rsplit(".", 1)[-1]
+    return _DTYPE_CANON.get(s, s)
+
+
+class _Ret(Exception):
+    def __init__(self, value: Any) -> None:
+        self.value = value
+
+
+# ---------------------------------------------------------- interpreter
+
+
+_BINOPS = {
+    ast.Add: lambda a, b: a + b,
+    ast.Sub: lambda a, b: a - b,
+    ast.Mult: lambda a, b: a * b,
+    ast.Div: lambda a, b: a / b,
+    ast.FloorDiv: lambda a, b: a // b,
+    ast.Mod: lambda a, b: a % b,
+    ast.Pow: lambda a, b: a ** b,
+}
+
+_CMPOPS = {
+    ast.Eq: lambda a, b: a == b,
+    ast.NotEq: lambda a, b: a != b,
+    ast.Lt: lambda a, b: a < b,
+    ast.LtE: lambda a, b: a <= b,
+    ast.Gt: lambda a, b: a > b,
+    ast.GtE: lambda a, b: a >= b,
+    ast.Is: lambda a, b: a is b,
+    ast.IsNot: lambda a, b: a is not b,
+    ast.In: lambda a, b: a in b,
+    ast.NotIn: lambda a, b: a not in b,
+}
+
+
+class _Interp:
+    """Structured evaluator for the ``*_impl`` grid builders.
+
+    Executes straight-line shape arithmetic, the ``nc == 1`` branch,
+    the spec-helper calls, and ``pl.pallas_call`` applications under
+    an environment of symbolic :class:`Tensor` operands, recording one
+    :class:`GridRecord` per grid launched.  Anything outside that
+    vocabulary raises :class:`ModelExtractionError` — by design: an
+    impl builder the model cannot follow is a checker violation, not
+    a silent gap."""
+
+    def __init__(self, model: "KernelModel") -> None:
+        self.m = model
+        self.records: List[GridRecord] = []
+
+    # -- entry
+
+    def call_function(self, fref: FuncRef, pos: Sequence[Any],
+                      kw: Dict[str, Any]) -> Any:
+        env = self._bind(fref, list(pos), dict(kw))
+        try:
+            self._exec_block(fref.node.body, env)
+        except _Ret as r:
+            return r.value
+        return None
+
+    def _bind(self, fref: FuncRef, pos: List[Any],
+              kw: Dict[str, Any]) -> Dict[str, Any]:
+        a = fref.node.args
+        names = [x.arg for x in a.args]
+        if len(pos) > len(names):
+            raise ModelExtractionError(
+                f"{fref.name}: {len(pos)} positional args for "
+                f"{len(names)} parameters")
+        env: Dict[str, Any] = {}
+        for n, v in zip(names, pos):
+            env[n] = v
+        for n in names:
+            if n not in env and n in kw:
+                env[n] = kw.pop(n)
+        ndef = len(a.defaults)
+        for i, d in enumerate(a.defaults):
+            n = names[len(names) - ndef + i]
+            if n not in env:
+                env[n] = self._eval(d, dict(env))
+        for ka, kd in zip(a.kwonlyargs, a.kw_defaults):
+            n = ka.arg
+            if n in kw:
+                env[n] = kw.pop(n)
+            elif kd is not None:
+                env[n] = self._eval(kd, dict(env))
+            else:
+                raise ModelExtractionError(
+                    f"{fref.name}: missing keyword-only arg {n!r}")
+        missing = [n for n in names if n not in env]
+        if missing or kw:
+            raise ModelExtractionError(
+                f"{fref.name}: missing={missing} unexpected={sorted(kw)}")
+        return env
+
+    # -- statements
+
+    def _exec_block(self, stmts: Sequence[ast.stmt],
+                    env: Dict[str, Any]) -> None:
+        for s in stmts:
+            if isinstance(s, ast.Return):
+                raise _Ret(self._eval(s.value, env)
+                           if s.value is not None else None)
+            elif isinstance(s, ast.Assign):
+                val = self._eval(s.value, env)
+                for t in s.targets:
+                    self._assign(t, val, env)
+            elif isinstance(s, ast.AnnAssign):
+                if s.value is not None:
+                    self._assign(s.target, self._eval(s.value, env), env)
+            elif isinstance(s, ast.Assert):
+                ok = self._eval(s.test, env)
+                if isinstance(ok, _Opaque):
+                    continue
+                if not ok:
+                    detail = ""
+                    if s.msg is not None:
+                        try:
+                            detail = f" [{self._eval(s.msg, env)!r}]"
+                        except Exception:
+                            pass
+                    raise ModelExtractionError(
+                        f"kernel shape contract failed at line {s.lineno}: "
+                        f"assert {ast.unparse(s.test)}{detail}")
+            elif isinstance(s, ast.If):
+                t = self._eval(s.test, env)
+                if isinstance(t, _Opaque):
+                    raise ModelExtractionError(
+                        f"opaque branch condition at line {s.lineno}: "
+                        f"{ast.unparse(s.test)}")
+                self._exec_block(s.body if t else s.orelse, env)
+            elif isinstance(s, ast.Expr):
+                self._eval(s.value, env)
+            elif isinstance(s, ast.FunctionDef):
+                env[s.name] = FuncRef(s.name, s)
+            elif isinstance(s, ast.Pass):
+                pass
+            else:
+                raise ModelExtractionError(
+                    f"unsupported statement {type(s).__name__} at line "
+                    f"{s.lineno}")
+
+    def _assign(self, target: ast.expr, val: Any,
+                env: Dict[str, Any]) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = val
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            try:
+                vals = list(val)
+            except TypeError:
+                raise ModelExtractionError(
+                    f"cannot unpack {val!r} at line {target.lineno}")
+            if len(vals) != len(target.elts):
+                raise ModelExtractionError(
+                    f"unpack arity mismatch at line {target.lineno}")
+            for t, v in zip(target.elts, vals):
+                self._assign(t, v, env)
+        else:
+            raise ModelExtractionError(
+                f"unsupported assignment target at line {target.lineno}")
+
+    # -- expressions
+
+    def _eval(self, node: ast.expr, env: Dict[str, Any]) -> Any:
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id in env:
+                return env[node.id]
+            if node.id in self.m.functions:
+                return FuncRef(node.id, self.m.functions[node.id])
+            if node.id in self.m.consts:
+                return self.m.consts[node.id]
+            raise ModelExtractionError(
+                f"unresolved name {node.id!r} at line {node.lineno}")
+        if isinstance(node, ast.Tuple):
+            return tuple(self._eval(e, env) for e in node.elts)
+        if isinstance(node, ast.List):
+            return [self._eval(e, env) for e in node.elts]
+        if isinstance(node, ast.Attribute):
+            return self._eval_attr(node, env)
+        if isinstance(node, ast.BinOp):
+            a = self._eval(node.left, env)
+            b = self._eval(node.right, env)
+            if isinstance(a, _Opaque) or isinstance(b, _Opaque):
+                return _Opaque("binop")
+            op = _BINOPS.get(type(node.op))
+            if op is None:
+                raise ModelExtractionError(
+                    f"unsupported operator at line {node.lineno}")
+            return op(a, b)
+        if isinstance(node, ast.UnaryOp):
+            v = self._eval(node.operand, env)
+            if isinstance(v, _Opaque):
+                return v
+            if isinstance(node.op, ast.USub):
+                return -v
+            if isinstance(node.op, ast.Not):
+                return not v
+            raise ModelExtractionError(
+                f"unsupported unary op at line {node.lineno}")
+        if isinstance(node, ast.BoolOp):
+            is_and = isinstance(node.op, ast.And)
+            result: Any = True if is_and else False
+            for v_node in node.values:
+                v = self._eval(v_node, env)
+                if isinstance(v, _Opaque):
+                    return v
+                result = v
+                if is_and and not v:
+                    return v
+                if not is_and and v:
+                    return v
+            return result
+        if isinstance(node, ast.Compare):
+            left = self._eval(node.left, env)
+            for op, comp in zip(node.ops, node.comparators):
+                right = self._eval(comp, env)
+                if isinstance(left, _Opaque) or isinstance(right, _Opaque):
+                    return _Opaque("compare")
+                fn = _CMPOPS.get(type(op))
+                if fn is None:
+                    raise ModelExtractionError(
+                        f"unsupported comparison at line {node.lineno}")
+                if not fn(left, right):
+                    return False
+                left = right
+            return True
+        if isinstance(node, ast.IfExp):
+            t = self._eval(node.test, env)
+            if isinstance(t, _Opaque):
+                return _Opaque("ifexp")
+            return self._eval(node.body if t else node.orelse, env)
+        if isinstance(node, ast.Subscript):
+            base = self._eval(node.value, env)
+            if isinstance(base, _Opaque):
+                return base
+            idx = self._eval(node.slice, env)
+            if isinstance(idx, _Opaque):
+                return _Opaque("subscript")
+            try:
+                return base[idx]
+            except Exception:
+                return _Opaque("subscript")
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env)
+        if isinstance(node, ast.Lambda):
+            return LambdaVal(node, dict(env), self)
+        if isinstance(node, ast.JoinedStr):
+            return "<fstring>"
+        raise ModelExtractionError(
+            f"unsupported expression {type(node).__name__} at line "
+            f"{getattr(node, 'lineno', '?')}")
+
+    def _eval_attr(self, node: ast.Attribute, env: Dict[str, Any]) -> Any:
+        v = node.value
+        if (isinstance(v, ast.Name) and v.id not in env
+                and v.id not in self.m.functions
+                and v.id not in self.m.consts):
+            base: Any = _Dotted(self.m.aliases.get(v.id, v.id))
+        else:
+            base = self._eval(v, env)
+        if isinstance(base, Tensor):
+            if node.attr == "shape":
+                return base.shape
+            if node.attr == "dtype":
+                return base.dtype
+            raise ModelExtractionError(
+                f"unsupported tensor attribute {node.attr!r} at line "
+                f"{node.lineno}")
+        if isinstance(base, _Dotted):
+            return _Dotted(str(base) + "." + node.attr)
+        if isinstance(base, _Opaque):
+            return base
+        raise ModelExtractionError(
+            f"unsupported attribute base {base!r} at line {node.lineno}")
+
+    def _eval_call(self, node: ast.Call, env: Dict[str, Any]) -> Any:
+        fv = self._eval(node.func, env)
+        pos: List[Any] = []
+        for a in node.args:
+            if isinstance(a, ast.Starred):
+                v = self._eval(a.value, env)
+                pos.extend(list(v))
+            else:
+                pos.append(self._eval(a, env))
+        kw: Dict[str, Any] = {}
+        for k in node.keywords:
+            if k.arg is None:
+                raise ModelExtractionError(
+                    f"**kwargs call at line {node.lineno}")
+            kw[k.arg] = self._eval(k.value, env)
+        if isinstance(fv, PallasCallObj):
+            return self._apply_pallas(fv, pos)
+        if isinstance(fv, FuncRef):
+            if fv.name == "_use_interpret":
+                return False
+            return self.call_function(fv, pos, kw)
+        if isinstance(fv, PartialFn):
+            merged = dict(fv.kwargs)
+            merged.update(kw)
+            return self.call_function(fv.ref, pos, merged)
+        if isinstance(fv, LambdaVal):
+            return fv(*pos)
+        if isinstance(fv, _Dotted):
+            leaf = str(fv).rsplit(".", 1)[-1]
+            if leaf == "partial":
+                f = pos[0]
+                if not isinstance(f, FuncRef):
+                    raise ModelExtractionError(
+                        f"functools.partial of non-module function at "
+                        f"line {node.lineno}")
+                return PartialFn(f, dict(kw))
+            if leaf == "BlockSpec":
+                block = tuple(pos[0]) if pos else tuple(kw["block_shape"])
+                idx = pos[1] if len(pos) > 1 else kw.get("index_map")
+                ms = kw.get("memory_space")
+                ms_leaf = (str(ms).rsplit(".", 1)[-1]
+                           if isinstance(ms, _Dotted) else
+                           ("" if ms is None else str(ms)))
+                if not isinstance(idx, (LambdaVal, type(None))):
+                    raise ModelExtractionError(
+                        f"non-lambda index_map at line {node.lineno}")
+                return SpecInstance(block, idx, ms_leaf, node.lineno)
+            if leaf == "ShapeDtypeStruct":
+                return Tensor("out", tuple(pos[0]), _dtype_name(pos[1]))
+            if leaf == "pallas_call":
+                grid = kw.get("grid") or ()
+                return PallasCallObj(
+                    pos[0], tuple(grid), kw.get("in_specs"),
+                    kw.get("out_specs"), kw.get("out_shape"), node.lineno)
+            return _Opaque(str(fv))
+        if isinstance(fv, _Opaque):
+            return fv
+        raise ModelExtractionError(
+            f"cannot call value {fv!r} at line {node.lineno}")
+
+    def _apply_pallas(self, pc: PallasCallObj,
+                      operands: Sequence[Any]) -> Any:
+        in_specs = list(pc.in_specs or [])
+        if len(in_specs) != len(operands):
+            raise ModelExtractionError(
+                f"pallas_call at line {pc.line}: {len(in_specs)} in_specs "
+                f"for {len(operands)} operands")
+        for op in operands:
+            if not isinstance(op, Tensor):
+                raise ModelExtractionError(
+                    f"pallas_call at line {pc.line}: non-tensor operand "
+                    f"{op!r}")
+        multi_out = isinstance(pc.out_shape, list)
+        outs = list(pc.out_shape) if multi_out else [pc.out_shape]
+        out_specs = (list(pc.out_specs) if isinstance(pc.out_specs, list)
+                     else [pc.out_specs])
+        if len(outs) != len(out_specs):
+            raise ModelExtractionError(
+                f"pallas_call at line {pc.line}: out_specs/out_shape "
+                f"arity mismatch")
+        kernel = pc.kernel
+        if isinstance(kernel, PartialFn):
+            kname, kkw = kernel.ref.name, dict(kernel.kwargs)
+        elif isinstance(kernel, FuncRef):
+            kname, kkw = kernel.name, {}
+        else:
+            raise ModelExtractionError(
+                f"pallas_call at line {pc.line}: unsupported kernel "
+                f"binding {kernel!r}")
+        self.records.append(GridRecord(
+            kernel_name=kname, kernel_kwargs=kkw, grid=pc.grid,
+            in_specs=list(zip(in_specs, operands)),
+            out_specs=list(zip(out_specs, outs)), line=pc.line))
+        return list(outs) if multi_out else outs[0]
+
+
+# -------------------------------------------------- census extraction
+
+
+def _range_extent(iter_node: ast.expr) -> Optional[int]:
+    if (isinstance(iter_node, ast.Call)
+            and isinstance(iter_node.func, ast.Name)
+            and iter_node.func.id == "range"
+            and len(iter_node.args) == 1
+            and isinstance(iter_node.args[0], ast.Constant)
+            and isinstance(iter_node.args[0].value, int)):
+        return iter_node.args[0].value
+    return None
+
+
+def _weighted_count(root: ast.AST, hit) -> int:
+    """Count nodes satisfying ``hit``, multiplying through ``for``
+    loops and comprehensions over literal ``range(k)`` — a plane built
+    inside ``for k in range(4)`` is 4 live planes."""
+    total = 0
+
+    def visit(n: ast.AST, mult: int) -> None:
+        nonlocal total
+        if hit(n):
+            total += mult
+        if isinstance(n, ast.For):
+            ext = _range_extent(n.iter) or 1
+            visit(n.iter, mult)
+            for c in n.body:
+                visit(c, mult * ext)
+            for c in n.orelse:
+                visit(c, mult)
+            return
+        if isinstance(n, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            ext = 1
+            for g in n.generators:
+                ext *= _range_extent(g.iter) or 1
+                visit(g.iter, mult)
+            visit(n.elt, mult * ext)
+            return
+        if isinstance(n, ast.DictComp):
+            ext = 1
+            for g in n.generators:
+                ext *= _range_extent(g.iter) or 1
+                visit(g.iter, mult)
+            visit(n.key, mult * ext)
+            visit(n.value, mult * ext)
+            return
+        for c in ast.iter_child_nodes(n):
+            visit(c, mult)
+
+    visit(root, 1)
+    return total
+
+
+def _calls_to(name: str):
+    return lambda n: (isinstance(n, ast.Call)
+                      and isinstance(n.func, ast.Name)
+                      and n.func.id == name)
+
+
+def _astype_calls(n: ast.AST) -> bool:
+    return (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "astype")
+
+
+def _stores_to(names) -> Any:
+    names = set(names)
+    return lambda n: (isinstance(n, ast.Subscript)
+                      and isinstance(n.ctx, ast.Store)
+                      and isinstance(n.value, ast.Name)
+                      and n.value.id in names)
+
+
+def _zeros_calls(n: ast.AST) -> bool:
+    return (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "zeros")
+
+
+# ------------------------------------------------------------- config
+
+
+@dataclass(frozen=True)
+class KernelConfig:
+    """One point in the kernel configuration space.
+
+    ``rowsp`` defaults to one tile (R=1) — the per-grid-step footprint
+    does not depend on it; pass a multiple of ``tile`` to exercise
+    grid-coverage checks over R > 1 steps."""
+    Mp: int
+    F: int
+    tile: int
+    nc: int = 1
+    B: int = 1
+    coh_dtype: str = "f32"
+    rowsp: Optional[int] = None
+    robust: bool = True
+
+    @property
+    def resolved_rowsp(self) -> int:
+        return self.rowsp if self.rowsp is not None else self.tile
+
+
+@dataclass
+class Footprint:
+    """Per-grid-step VMEM residency breakdown, in bytes."""
+    family: str
+    config: KernelConfig
+    census: int
+    rows: int
+    block_bytes: int
+    onehot_bytes: int
+    lane_bytes: int
+    scratch_raw_bytes: int
+    factor: float
+    total_bytes: int
+    record: GridRecord = field(repr=False, default=None)
+
+    @property
+    def mib(self) -> float:
+        return self.total_bytes / MIB
+
+
+# -------------------------------------------------------------- model
+
+
+class KernelModel:
+    """The symbolic VMEM model extracted from one kernel source."""
+
+    #: helper functions the census extraction requires; their absence
+    #: means the kernel was restructured and the model must follow.
+    _REQUIRED = ("_expand_gains", "_load_coh_planes", "_cjqh", "_jp_a",
+                 "_bwd_accumulate", "_g_from_residual_batch", "_onehots",
+                 "_sel_dot")
+
+    def __init__(self, source: str, path: str = "<source>") -> None:
+        self.source = source
+        self.path = path
+        self.sha256 = hashlib.sha256(source.encode("utf-8")).hexdigest()
+        try:
+            self.tree = ast.parse(source)
+        except SyntaxError as e:
+            raise ModelExtractionError(f"cannot parse {path}: {e}")
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.consts: Dict[str, Any] = {}
+        self.aliases: Dict[str, str] = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.Assign):
+                if (len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Constant)):
+                    self.consts[node.targets[0].id] = node.value.value
+            elif isinstance(node, ast.Import):
+                for al in node.names:
+                    self.aliases[al.asname or al.name.split(".")[0]] = (
+                        al.name if al.asname else al.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                for al in node.names:
+                    self.aliases[al.asname or al.name] = (
+                        f"{node.module}.{al.name}" if node.module
+                        else al.name)
+        missing = [f for f in self._REQUIRED if f not in self.functions]
+        if missing:
+            raise ModelExtractionError(
+                f"kernel helpers missing from {path}: {missing} — the "
+                "VMEM model no longer matches the kernel structure")
+        self.counts = self._extract_counts()
+        self.expand_calls = {
+            k: _weighted_count(self.functions[k], _calls_to("_expand_gains"))
+            for k in KERNEL_FAMILY if k in self.functions
+        }
+        self._factors: Optional[Dict[str, float]] = None
+
+    # -- extraction
+
+    def _extract_counts(self) -> Dict[str, int]:
+        fns = self.functions
+        # _sel_dot planes per _expand_gains call: the nc == 1 branch
+        # (solo path); fall back to the whole body if restructured.
+        eg = fns["_expand_gains"]
+        sel_scope: ast.AST = eg
+        for n in ast.walk(eg):
+            if isinstance(n, ast.If):
+                try:
+                    if ast.unparse(n.test).replace(" ", "") == "nc==1":
+                        sel_scope = ast.Module(body=n.body,
+                                               type_ignores=[])
+                        break
+                except Exception:
+                    pass
+        counts = {
+            "sel_planes": _weighted_count(sel_scope, _calls_to("_sel_dot")),
+            "load_planes": _weighted_count(fns["_load_coh_planes"],
+                                           _astype_calls),
+            "cjqh_planes": _weighted_count(fns["_cjqh"],
+                                           _stores_to(("a_re", "a_im"))),
+            "jpa_planes": _weighted_count(fns["_jp_a"],
+                                          _stores_to(("v_re", "v_im"))),
+            "acc_zeros": _weighted_count(fns["_bwd_accumulate"],
+                                         _zeros_calls),
+            "da_planes": _weighted_count(fns["_bwd_accumulate"],
+                                         _stores_to(("da_re", "da_im"))),
+            "lane_bcast_planes": _weighted_count(
+                fns["_g_from_residual_batch"], _calls_to("_lane_bcast")),
+            "onehot_planes": _weighted_count(fns["_onehots"],
+                                             _astype_calls),
+        }
+        return counts
+
+    # -- symbolic execution
+
+    def _operands(self, family: str,
+                  cfg: KernelConfig) -> Tuple[List[Tensor], Dict[str, Any]]:
+        npad = int(self.consts.get("NPAD", 128))
+        rowsp = cfg.resolved_rowsp
+        batch = family.startswith("cost_batch")
+        mrows = (cfg.B * cfg.Mp) if batch else (cfg.Mp * cfg.nc)
+        tab_re = Tensor("tab_re", (4, mrows, npad), "f32")
+        tab_im = Tensor("tab_im", (4, mrows, npad), "f32")
+        ant_p = Tensor("ant_p", (1, rowsp), "i32")
+        ant_q = Tensor("ant_q", (1, rowsp), "i32")
+        if batch:
+            coh = Tensor("coh_ri", (cfg.B * cfg.Mp, cfg.F, 8, rowsp),
+                         cfg.coh_dtype)
+            vis = Tensor("vis_ri", (cfg.B, cfg.F, 8, rowsp), "f32")
+            mask = Tensor("mask_p", (cfg.B, cfg.F, rowsp), "f32")
+            nu = Tensor("nu_rows", (cfg.B, npad), "f32")
+            pos = [tab_re, tab_im, coh, ant_p, ant_q, vis, mask, nu]
+            kw: Dict[str, Any] = {"robust": cfg.robust, "tile": cfg.tile}
+            return pos, kw
+        coh = Tensor("coh_ri", (cfg.Mp, cfg.F, 8, rowsp), cfg.coh_dtype)
+        kw = {"tile": cfg.tile}
+        if cfg.nc > 1:
+            kw["nc"] = cfg.nc
+            kw["cmap"] = Tensor("cmap", (cfg.Mp, rowsp), "i32")
+        if family == "predict_fwd":
+            pos = [tab_re, tab_im, coh, ant_p, ant_q]
+        elif family == "predict_bwd":
+            g_ri = Tensor("g_ri", (cfg.F, 8, rowsp), "f32")
+            pos = [tab_re, tab_im, coh, ant_p, ant_q, g_ri]
+        else:  # cost_fwd / cost_bwd
+            vis = Tensor("vis_ri", (cfg.F, 8, rowsp), "f32")
+            mask = Tensor("mask_p", (cfg.F, rowsp), "f32")
+            nu = Tensor("nu_arr", (1, 1), "f32")
+            pos = [tab_re, tab_im, coh, ant_p, ant_q, vis, mask, nu]
+            kw["robust"] = cfg.robust
+        return pos, kw
+
+    def grid_record(self, family: str, cfg: KernelConfig) -> GridRecord:
+        """Symbolically execute one family's impl builder and return
+        its recorded grid."""
+        if family not in IMPLS:
+            raise ModelExtractionError(f"unknown family {family!r}")
+        impl = IMPLS[family]
+        if impl not in self.functions:
+            raise ModelExtractionError(
+                f"impl builder {impl} missing from {self.path}")
+        interp = _Interp(self)
+        pos, kw = self._operands(family, cfg)
+        interp.call_function(
+            FuncRef(impl, self.functions[impl]), pos, kw)
+        if len(interp.records) != 1:
+            raise ModelExtractionError(
+                f"{impl}: expected exactly one pallas_call, recorded "
+                f"{len(interp.records)}")
+        rec = interp.records[0]
+        if rec.kernel_name not in KERNEL_FAMILY:
+            raise ModelExtractionError(
+                f"{impl}: unknown kernel {rec.kernel_name!r}")
+        return rec
+
+    # -- census / calibration
+
+    def census(self, kernel_name: str, F: int, nc: int = 1) -> int:
+        c = self.counts
+        G = c["sel_planes"] * self.expand_calls.get(kernel_name, 2)
+        L, C, V = c["load_planes"], c["cjqh_planes"], c["jpa_planes"]
+        A, DA, LG = c["acc_zeros"], c["da_planes"], c["lane_bcast_planes"]
+        fam = KERNEL_FAMILY[kernel_name]
+        fwd = G + F * (L + C + V)
+        bwd = G + 2 * A + F * (L + C + DA + V)
+        n = {
+            "predict_fwd": fwd,
+            "predict_bwd": bwd,
+            "cost_fwd": fwd,
+            # the objective backward re-forms the model via _jp_a
+            "cost_bwd": bwd + F * V,
+            "cost_batch_fwd": fwd,
+            # + lane-broadcast cotangent planes
+            "cost_batch_bwd": bwd + F * (V + LG),
+        }[fam]
+        if nc > 1:
+            # hybrid: nc chunk-selector masks + per-component reshaped
+            # selection planes
+            n += nc + c["sel_planes"]
+        return n
+
+    def factors(self) -> Dict[str, float]:
+        """Per-direction calibration factors fitted over the hardware
+        anchors: ``max(1, observed / raw)``, applied to the census
+        scratch term only (block arithmetic is exact)."""
+        if self._factors is None:
+            f = {"fwd": 1.0, "bwd": 1.0}
+            for a in HARDWARE_ANCHORS:
+                cfg = KernelConfig(Mp=a["Mp"], F=a["F"], tile=a["tile"])
+                fp = self.footprint(a["family"], cfg, calibrated=False)
+                bucket = _factor_bucket(a["family"])
+                f[bucket] = max(f[bucket],
+                                a["observed_bytes"] / fp.total_bytes)
+            self._factors = f
+        return self._factors
+
+    # -- footprint
+
+    def footprint(self, family: str, cfg: KernelConfig,
+                  calibrated: bool = True) -> Footprint:
+        rec = self.grid_record(family, cfg)
+        kk = rec.kernel_kwargs
+        T = int(kk["T"])
+        F = int(kk["F"])
+        rows = int(kk["MP"]) * int(kk.get("B", 1))
+        nc = int(kk.get("NC", 1))
+        census = self.census(rec.kernel_name, F, nc)
+        blocks = 0
+        for spec, tensor in rec.in_specs + rec.out_specs:
+            if spec.memory_space != "VMEM":
+                continue
+            buf = 2 if spec.streamed() else 1
+            blocks += (_prod(spec.block_shape)
+                       * _DTYPE_BYTES[tensor.dtype] * buf)
+        npad = int(self.consts.get("NPAD", 128))
+        onehot = self.counts["onehot_planes"] * npad * T * 4
+        lane = 0
+        if "B" in kk:
+            # second-order (B, T) planes: per-freq residual/cotangent
+            # components + mask, plus the running cost accumulator and
+            # nu column
+            lane = (F * 9 + 2) * int(kk["B"]) * T * 4
+        raw = census * rows * T * 4
+        fac = (self.factors()[_factor_bucket(family)]
+               if calibrated else 1.0)
+        # per-row ceiling keeps the total EXACTLY affine in rows, so
+        # batch_rows_max can invert it without quantization slop
+        total = (blocks + onehot + lane
+                 + rows * int(math.ceil(census * T * 4 * fac)))
+        return Footprint(
+            family=family, config=cfg, census=census, rows=rows,
+            block_bytes=blocks, onehot_bytes=onehot, lane_bytes=lane,
+            scratch_raw_bytes=raw, factor=fac, total_bytes=total,
+            record=rec)
+
+    # -- HBM totals (cross-checked against jax memory_analysis on CPU)
+
+    def hbm_operand_bytes(self, family: str, cfg: KernelConfig) -> int:
+        rec = self.grid_record(family, cfg)
+        return sum(t.nbytes for _, t in rec.in_specs)
+
+    def hbm_output_bytes(self, family: str, cfg: KernelConfig) -> int:
+        rec = self.grid_record(family, cfg)
+        return sum(t.nbytes for _, t in rec.out_specs)
+
+    # -- grid coverage
+
+    def coverage_problems(self, family: str,
+                          cfg: KernelConfig) -> List[str]:
+        """Index-map/grid hazards checked numerically: block rank vs
+        index rank, and whether the grid's index sequence tiles each
+        operand axis exactly (const axes must carry the full extent;
+        stepped axes must satisfy block * R == extent with indices
+        0..R-1)."""
+        if cfg.rowsp is None:
+            cfg = KernelConfig(**{**cfg.__dict__, "rowsp": 4 * cfg.tile})
+        rec = self.grid_record(family, cfg)
+        if len(rec.grid) != 1:
+            return [f"{family}: expected a 1-d grid, got {rec.grid}"]
+        R = int(rec.grid[0])
+        problems: List[str] = []
+        for spec, tensor in rec.in_specs + rec.out_specs:
+            where = (f"{family}: {tensor.name} BlockSpec at line "
+                     f"{spec.line}")
+            if spec.index_map is None:
+                problems.append(f"{where}: missing index_map")
+                continue
+            idxs = [tuple(spec.index_map(r)) for r in range(R)]
+            if len(idxs[0]) != len(spec.block_shape):
+                problems.append(
+                    f"{where}: index_map rank {len(idxs[0])} != block "
+                    f"rank {len(spec.block_shape)}")
+                continue
+            if len(spec.block_shape) != len(tensor.shape):
+                problems.append(
+                    f"{where}: block rank {len(spec.block_shape)} != "
+                    f"operand rank {len(tensor.shape)}")
+                continue
+            for ax in range(len(spec.block_shape)):
+                vals = [ix[ax] for ix in idxs]
+                blk = spec.block_shape[ax]
+                ext = tensor.shape[ax]
+                if all(v == vals[0] for v in vals):
+                    if vals[0] != 0 or blk != ext:
+                        problems.append(
+                            f"{where}: axis {ax} constant index "
+                            f"{vals[0]} with block {blk} does not cover "
+                            f"extent {ext}")
+                else:
+                    if vals != list(range(R)) or blk * R != ext:
+                        problems.append(
+                            f"{where}: axis {ax} indices {vals} with "
+                            f"block {blk} x grid {R} do not cover "
+                            f"extent {ext}")
+        return problems
+
+    # -- derived contracts
+
+    def feasible_tiles(self, backend: str = DEFAULT_BACKEND,
+                       Mp: Optional[int] = None,
+                       F: Optional[int] = None) -> Dict[str, Dict[int, dict]]:
+        ceiling = CEILINGS[backend]
+        Mp = NORTH_STAR["Mp"] if Mp is None else Mp
+        F = NORTH_STAR["F"] if F is None else F
+        out: Dict[str, Dict[int, dict]] = {}
+        for fam in FAMILIES:
+            row: Dict[int, dict] = {}
+            for tile in SWEEP_TILES:
+                if fam.startswith("cost_batch"):
+                    cfg = KernelConfig(Mp=8, B=Mp // 8, F=F, tile=tile)
+                else:
+                    cfg = KernelConfig(Mp=Mp, F=F, tile=tile)
+                fp = self.footprint(fam, cfg)
+                row[tile] = {"bytes": fp.total_bytes,
+                             "feasible": fp.total_bytes <= ceiling}
+            out[fam] = row
+        return out
+
+    def derived_full_cluster_tile(self,
+                                  backend: str = DEFAULT_BACKEND) -> int:
+        ft = self.feasible_tiles(backend)
+        best = 0
+        for tile in SWEEP_TILES:
+            if all(ft[f][tile]["feasible"]
+                   for f in DIFFERENTIATED_FAMILIES):
+                best = max(best, tile)
+        return best
+
+    def batch_rows_max(self, tile: Optional[int] = None,
+                       coh_dtype: str = "f32",
+                       backend: str = DEFAULT_BACKEND,
+                       F: Optional[int] = None) -> int:
+        """Proven-envelope row bound for the batched objective (module
+        docstring).  The footprint is exactly affine in ``rows`` at a
+        fixed tile/dtype, so the bound is recovered by evaluating two
+        points and inverting — no quantization slop: the f32 bound at
+        the envelope tile reproduces the proven 104 rows exactly, and
+        bf16's halved coherency stream buys its extra rows at byte
+        resolution."""
+        if tile is None:
+            tile = int(self.consts.get("FULL_CLUSTER_TILE", 128))
+        F = NORTH_STAR["F"] if F is None else F
+        env = PROVEN_BATCH_ENVELOPE
+        e = self.footprint("cost_batch_bwd", KernelConfig(
+            Mp=8, B=env["rows"] // 8, F=F, tile=env["tile"],
+            coh_dtype=env["coh_dtype"])).total_bytes
+        bound = min(e, CEILINGS[backend])
+        f8 = self.footprint("cost_batch_bwd", KernelConfig(
+            Mp=8, B=1, F=F, tile=tile, coh_dtype=coh_dtype)).total_bytes
+        f16 = self.footprint("cost_batch_bwd", KernelConfig(
+            Mp=8, B=2, F=F, tile=tile, coh_dtype=coh_dtype)).total_bytes
+        per_row = (f16 - f8) // 8
+        fixed = f8 - 8 * per_row
+        if per_row <= 0 or bound <= fixed:
+            return 0
+        return int((bound - fixed) // per_row)
+
+    # -- table artifact
+
+    def build_table(self, backend: str = DEFAULT_BACKEND) -> dict:
+        ft = self.feasible_tiles(backend)
+        const_keys = ("NPAD", "DEF_TILE", "FULL_CLUSTER_TILE",
+                      "MAX_GRID_ROWS")
+        return {
+            "version": 1,
+            "model_version": MODEL_VERSION,
+            "backend": backend,
+            "ceiling_bytes": CEILINGS[backend],
+            "north_star": dict(NORTH_STAR),
+            "constants": {k: self.consts[k] for k in const_keys
+                          if k in self.consts},
+            "census_counts": dict(self.counts),
+            "calibration": {k: round(v, 6)
+                            for k, v in sorted(self.factors().items())},
+            "anchors": [dict(a) for a in HARDWARE_ANCHORS],
+            "proven_batch_envelope": dict(PROVEN_BATCH_ENVELOPE),
+            "feasible_tiles": {
+                fam: {str(t): ft[fam][t] for t in SWEEP_TILES}
+                for fam in FAMILIES},
+            "derived": {
+                "full_cluster_tile":
+                    self.derived_full_cluster_tile(backend)},
+            "batch_rows_max": {
+                dt: {str(t): self.batch_rows_max(tile=t, coh_dtype=dt,
+                                                 backend=backend)
+                     for t in SWEEP_TILES}
+                for dt in ("f32", "bf16")},
+            "fingerprint": {"rime_kernel_sha256": self.sha256,
+                            "model_version": MODEL_VERSION},
+        }
+
+
+def _factor_bucket(family: str) -> str:
+    return "bwd" if family.endswith("bwd") else "fwd"
+
+
+def default_kernel_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ops", "rime_kernel.py")
+
+
+def load_model(path: Optional[str] = None,
+               source: Optional[str] = None) -> KernelModel:
+    """Load the VMEM model from kernel source (defaults to the
+    in-tree ``ops/rime_kernel.py``)."""
+    if source is None:
+        path = path or default_kernel_path()
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+    return KernelModel(source, path=path or "<source>")

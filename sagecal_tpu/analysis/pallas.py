@@ -1,0 +1,208 @@
+"""Shared Pallas-site discovery for the kernel-aware lint rules.
+
+JL014 (precision flow) and JL015 (BlockSpec hazards) both need to know
+which functions ARE Pallas kernel bodies and which array operands feed
+them.  The repo's idiom (``ops/rime_kernel.py``) binds kernels and
+operand tuples branch-locally::
+
+    if nc == 1:
+        kernel = functools.partial(_fwd_kernel, F=F, MP=Mp, T=tile)
+        args = (ant_p, ant_q, tab_re, tab_im, coh_ri)
+    else:
+        kernel = functools.partial(_fwd_kernel_hybrid, ...)
+        args = (ant_p, ant_q, cmap, tab_re, tab_im, coh_ri)
+    return pl.pallas_call(kernel, ...)(*args)
+
+so kernel/operand resolution must pair the ``kernel = ...`` and
+``args = (...)`` assignments from the SAME statement block — a naive
+cross-product would bind the solo kernel to the hybrid operand tuple
+and shift every positional parameter by one.  Direct applications
+(``pl.pallas_call(k, ...)(a, b, c)``) resolve exactly.
+
+Pure stdlib ``ast`` — no jax import (lint/CI context).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from sagecal_tpu.analysis.callgraph import ModuleInfo, qual_of
+
+
+def is_pallas_module(mi: ModuleInfo) -> bool:
+    """Whether the module imports the Pallas API."""
+    return any(target.startswith("jax.experimental.pallas")
+               for target in mi.imports.values())
+
+
+def module_functions(mi: ModuleInfo) -> Dict[str, ast.FunctionDef]:
+    """Top-level function definitions by name."""
+    if mi.tree is None:
+        return {}
+    return {n.name: n for n in mi.tree.body
+            if isinstance(n, ast.FunctionDef)}
+
+
+def positional_params(fnode: ast.FunctionDef) -> List[str]:
+    """Positional parameter names (keyword-only statics excluded) —
+    the names pallas_call operands bind to, in order."""
+    return [a.arg for a in fnode.args.args]
+
+
+def _is_pallas_call(node: ast.AST, mi: ModuleInfo) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    q = qual_of(node.func, mi.imports, mi.toplevel, mi.name) or ""
+    return q.endswith(".pallas_call") or q == "pallas_call"
+
+
+def _partial_kernel_name(expr: ast.expr, mi: ModuleInfo,
+                         fns: Dict[str, ast.FunctionDef]) -> Optional[str]:
+    """Kernel function named by ``functools.partial(fn, ...)`` or a
+    direct module-function reference."""
+    if isinstance(expr, ast.Name) and expr.id in fns:
+        return expr.id
+    if isinstance(expr, ast.Call):
+        q = qual_of(expr.func, mi.imports, mi.toplevel, mi.name) or ""
+        if q.endswith(".partial") and expr.args:
+            inner = expr.args[0]
+            if isinstance(inner, ast.Name) and inner.id in fns:
+                return inner.id
+    return None
+
+
+def _blocks(fn_node: ast.FunctionDef) -> List[List[ast.stmt]]:
+    """Every statement block in the function: the body plus each
+    branch/loop body — the granularity at which kernel/args pairs are
+    considered bound together."""
+    out: List[List[ast.stmt]] = [fn_node.body]
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.If):
+            out.append(n.body)
+            if n.orelse:
+                out.append(n.orelse)
+        elif isinstance(n, (ast.For, ast.While)):
+            out.append(n.body)
+    return out
+
+
+@dataclass
+class KernelBinding:
+    """One resolved (kernel function, positional operand exprs) pair."""
+    kernel_name: str
+    operand_exprs: List[ast.expr] = field(default_factory=list)
+
+
+@dataclass
+class PallasSite:
+    """One ``pl.pallas_call`` occurrence in a module."""
+    mi: ModuleInfo
+    call: ast.Call                 # the pallas_call(...) expression
+    apply_call: Optional[ast.Call]  # the outer (...)(operands) call
+    bindings: List[KernelBinding] = field(default_factory=list)
+
+
+def find_pallas_sites(mi: ModuleInfo) -> List[PallasSite]:
+    """Discover every pallas_call in a module with its kernel/operand
+    bindings resolved (block-paired, see module docstring)."""
+    if mi.tree is None or not is_pallas_module(mi):
+        return []
+    fns = module_functions(mi)
+    sites: List[PallasSite] = []
+    # application: the Call whose func IS a pallas_call Call node
+    applications: Dict[int, ast.Call] = {}
+    for n in ast.walk(mi.tree):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Call)
+                and _is_pallas_call(n.func, mi)):
+            applications[id(n.func)] = n
+    for fn in fns.values():
+        blocks = _blocks(fn)
+        # per-block name -> value-expr maps (last assignment wins)
+        block_assigns: List[Dict[str, ast.expr]] = []
+        for blk in blocks:
+            m: Dict[str, ast.expr] = {}
+            for s in blk:
+                if (isinstance(s, ast.Assign) and len(s.targets) == 1
+                        and isinstance(s.targets[0], ast.Name)):
+                    m[s.targets[0].id] = s.value
+            block_assigns.append(m)
+        for n in ast.walk(fn):
+            if not _is_pallas_call(n, mi):
+                continue
+            site = PallasSite(mi=mi, call=n,
+                              apply_call=applications.get(id(n)))
+            site.bindings = _resolve_bindings(
+                n, site.apply_call, mi, fns, block_assigns)
+            sites.append(site)
+    return sites
+
+
+def _resolve_bindings(call: ast.Call, apply_call: Optional[ast.Call],
+                      mi: ModuleInfo, fns: Dict[str, ast.FunctionDef],
+                      block_assigns: List[Dict[str, ast.expr]],
+                      ) -> List[KernelBinding]:
+    if not call.args:
+        return []
+    kexpr = call.args[0]
+    # kernel candidates: block index -> kernel name (None = unconditional)
+    kernel_cands: List[Tuple[Optional[int], str]] = []
+    direct = _partial_kernel_name(kexpr, mi, fns)
+    if direct is not None:
+        kernel_cands.append((None, direct))
+    elif isinstance(kexpr, ast.Name):
+        for bi, assigns in enumerate(block_assigns):
+            if kexpr.id in assigns:
+                kname = _partial_kernel_name(assigns[kexpr.id], mi, fns)
+                if kname is not None:
+                    kernel_cands.append((bi, kname))
+    # operand candidates
+    op_cands: List[Tuple[Optional[int], List[ast.expr]]] = []
+    if apply_call is not None:
+        args = apply_call.args
+        if len(args) == 1 and isinstance(args[0], ast.Starred):
+            star = args[0].value
+            if isinstance(star, ast.Name):
+                for bi, assigns in enumerate(block_assigns):
+                    v = assigns.get(star.id)
+                    if isinstance(v, (ast.Tuple, ast.List)):
+                        op_cands.append((bi, list(v.elts)))
+        elif not any(isinstance(a, ast.Starred) for a in args):
+            op_cands.append((None, list(args)))
+    bindings: List[KernelBinding] = []
+    if not op_cands:
+        for _, kname in kernel_cands:
+            bindings.append(KernelBinding(kname, []))
+        return bindings
+    for kbi, kname in kernel_cands:
+        for obi, ops in op_cands:
+            # block-paired: branch-local kernel only binds the SAME
+            # branch's operand tuple
+            if kbi is not None and obi is not None and kbi != obi:
+                continue
+            bindings.append(KernelBinding(kname, ops))
+    return bindings
+
+
+def kernel_names(sites: List[PallasSite]) -> Set[str]:
+    return {b.kernel_name for s in sites for b in s.bindings}
+
+
+def kernel_reachable(mi: ModuleInfo, roots: Set[str]) -> Set[str]:
+    """Module-local functions reachable from the kernel bodies via
+    direct calls (nested defs are visited as part of their enclosing
+    top-level function's subtree)."""
+    fns = module_functions(mi)
+    seen: Set[str] = set()
+    work = [r for r in roots if r in fns]
+    while work:
+        f = work.pop()
+        if f in seen:
+            continue
+        seen.add(f)
+        for n in ast.walk(fns[f]):
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id in fns and n.func.id not in seen):
+                work.append(n.func.id)
+    return seen

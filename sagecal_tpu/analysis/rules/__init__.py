@@ -22,6 +22,9 @@ from sagecal_tpu.analysis.rules.jl009 import UnguardedPickleLoad
 from sagecal_tpu.analysis.rules.jl010 import RawClockInLeaseLogic
 from sagecal_tpu.analysis.rules.jl011 import UseAfterDonation
 from sagecal_tpu.analysis.rules.jl012 import MixedDtypeComparison
+from sagecal_tpu.analysis.rules.jl013 import CotangentCompleteness
+from sagecal_tpu.analysis.rules.jl014 import PrecisionFlow
+from sagecal_tpu.analysis.rules.jl015 import BlockSpecHazard
 from sagecal_tpu.analysis.rules.jl900 import DeadImport
 
 
@@ -39,5 +42,8 @@ def all_rules() -> List[Type[Rule]]:
         RawClockInLeaseLogic,
         UseAfterDonation,
         MixedDtypeComparison,
+        CotangentCompleteness,
+        PrecisionFlow,
+        BlockSpecHazard,
         DeadImport,
     ]

@@ -1,0 +1,376 @@
+"""JL013: custom_vjp cotangent completeness.
+
+A ``jax.custom_vjp`` backward that silently returns ``None`` for a
+differentiable primal argument manufactures a zero gradient: JAX treats
+the slot as a symbolic zero, autodiff "succeeds", and the optimizer
+quietly never moves that parameter.  This is exactly the failure class
+the fused coherency path guards against at *runtime* with the
+``FUSED_COHERENCY_COTANGENT`` capability refusal — this rule makes the
+contract a commit-time proof instead of a hardware-day surprise.
+
+A ``None`` cotangent slot is accepted only through one of three
+explicit routes:
+
+1. **refusal** — the backward unconditionally raises (no ``return``
+   path), so the missing cotangent can never silently flow
+   (``sky_constant``'s ``FusedSkyGradientError`` pattern);
+2. **stop-gradient guard** — EVERY in-module call site of the
+   custom_vjp primal passes that argument through
+   ``jax.lax.stop_gradient`` (directly, or via a local that is
+   assigned from ``stop_gradient``/a ``dynamic_slice`` of such a
+   local), so no cotangent for the slot is ever requested.  At least
+   one call site must exist — an uncalled primal with a ``None`` slot
+   is still a trap for the first caller;
+3. **capability declaration** — the module declares, at top level,
+   ``<FLAG> = False`` together with ``<FLAG>_ARGS = ("argname", ...)``
+   naming the argument.  This is the machine-checkable form of the
+   existing ``FUSED_COHERENCY_COTANGENT`` contract: the flag documents
+   the missing cotangent, callers can introspect it, and flipping the
+   flag to ``True`` without implementing the cotangent becomes a lint
+   violation ("capability promises a cotangent").
+
+The rule also checks backward-return arity against the primal's
+differentiable argument count (positional parameters minus
+``nondiff_argnums``) — an off-by-one there mis-aligns every cotangent
+after the gap.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from sagecal_tpu.analysis.engine import Finding, Rule
+from sagecal_tpu.analysis.callgraph import ModuleInfo, qual_of
+
+
+def _qual(node: ast.AST, mi: ModuleInfo) -> str:
+    if not isinstance(node, (ast.Name, ast.Attribute)):
+        return ""
+    return qual_of(node, mi.imports, mi.toplevel, mi.name) or ""
+
+
+def _int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Literal tuple/list of ints (or a single int), else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+class _Primal:
+    """One custom_vjp-wrapped primal discovered in a module."""
+
+    def __init__(self, name: str, fdef: ast.FunctionDef,
+                 nondiff: Tuple[int, ...]):
+        self.name = name
+        self.fdef = fdef
+        self.nondiff = set(nondiff)
+        params = [a.arg for a in fdef.args.args]
+        self.diff_params: List[str] = [
+            p for i, p in enumerate(params) if i not in self.nondiff]
+        # primal positional index of each differentiable param
+        self.diff_pos: List[int] = [
+            i for i in range(len(params)) if i not in self.nondiff]
+
+
+def _nondiff_from_decorator(dec: ast.expr, mi: ModuleInfo,
+                            ) -> Optional[Tuple[int, ...]]:
+    """() for bare ``@jax.custom_vjp``; the literal tuple for
+    ``@functools.partial(jax.custom_vjp, nondiff_argnums=...)``;
+    None when the decorator is not a custom_vjp form."""
+    if _qual(dec, mi).endswith("jax.custom_vjp"):
+        return ()
+    if isinstance(dec, ast.Call):
+        q = _qual(dec.func, mi)
+        if q.endswith("jax.custom_vjp"):
+            for kw in dec.keywords:
+                if kw.arg == "nondiff_argnums":
+                    return _int_tuple(kw.value) or ()
+            return ()
+        if q.endswith(".partial") and dec.args:
+            if _qual(dec.args[0], mi).endswith("jax.custom_vjp"):
+                for kw in dec.keywords:
+                    if kw.arg == "nondiff_argnums":
+                        return _int_tuple(kw.value) or ()
+                return ()
+    return None
+
+
+def _collect_fdefs(tree: ast.Module) -> Dict[str, List[ast.FunctionDef]]:
+    out: Dict[str, List[ast.FunctionDef]] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.FunctionDef):
+            out.setdefault(n.name, []).append(n)
+    return out
+
+
+def _collect_primals(mi: ModuleInfo,
+                     fdefs: Dict[str, List[ast.FunctionDef]],
+                     ) -> Dict[str, _Primal]:
+    primals: Dict[str, _Primal] = {}
+    for cands in fdefs.values():
+        for fdef in cands:
+            for dec in fdef.decorator_list:
+                nd = _nondiff_from_decorator(dec, mi)
+                if nd is not None:
+                    primals[fdef.name] = _Primal(fdef.name, fdef, nd)
+    # assignment form: X = jax.custom_vjp(f, nondiff_argnums=...)
+    for n in ast.walk(mi.tree):
+        if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and isinstance(n.value, ast.Call)):
+            continue
+        if not _qual(n.value.func, mi).endswith("jax.custom_vjp"):
+            continue
+        if not (n.value.args and isinstance(n.value.args[0], ast.Name)):
+            continue
+        inner = fdefs.get(n.value.args[0].id)
+        if not inner:
+            continue
+        nd: Tuple[int, ...] = ()
+        for kw in n.value.keywords:
+            if kw.arg == "nondiff_argnums":
+                nd = _int_tuple(kw.value) or ()
+        primals[n.targets[0].id] = _Primal(
+            n.targets[0].id, inner[0], nd)
+    return primals
+
+
+def _capabilities(mi: ModuleInfo) -> Dict[str, List[Tuple[str, bool]]]:
+    """argname -> [(FLAG, value)] from paired module-level
+    ``FLAG = bool`` / ``FLAG_ARGS = ("argname", ...)`` declarations."""
+    flags: Dict[str, bool] = {}
+    flag_args: Dict[str, Tuple[str, ...]] = {}
+    for n in mi.tree.body:
+        if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)):
+            continue
+        name = n.targets[0].id
+        if (isinstance(n.value, ast.Constant)
+                and isinstance(n.value.value, bool)):
+            flags[name] = n.value.value
+        elif name.endswith("_ARGS"):
+            vals = _int_like_str_tuple(n.value)
+            if vals is not None:
+                flag_args[name[:-len("_ARGS")]] = vals
+    caps: Dict[str, List[Tuple[str, bool]]] = {}
+    for flag, args in flag_args.items():
+        if flag not in flags:
+            continue
+        for a in args:
+            caps.setdefault(a, []).append((flag, flags[flag]))
+    return caps
+
+
+def _int_like_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for e in node.elts:
+        if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+            return None
+        out.append(e.value)
+    return tuple(out)
+
+
+def _body_walk_no_nested(fdef: ast.FunctionDef) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested defs."""
+    stack: List[ast.AST] = list(fdef.body)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _always_raises(fdef: ast.FunctionDef) -> bool:
+    has_raise = False
+    for n in _body_walk_no_nested(fdef):
+        if isinstance(n, ast.Return):
+            return False
+        if isinstance(n, ast.Raise):
+            has_raise = True
+    return has_raise
+
+
+def _return_elts(fdef: ast.FunctionDef) -> Optional[List[ast.expr]]:
+    for n in _body_walk_no_nested(fdef):
+        if isinstance(n, ast.Return) and n.value is not None:
+            if isinstance(n.value, ast.Tuple):
+                return list(n.value.elts)
+            return [n.value]
+    return None
+
+
+def _is_stop_gradient(expr: ast.AST, mi: ModuleInfo) -> bool:
+    return (isinstance(expr, ast.Call)
+            and _qual(expr.func, mi).endswith("stop_gradient"))
+
+
+def _guarded_locals(fn: ast.FunctionDef, mi: ModuleInfo) -> Set[str]:
+    """Fixpoint of locals holding stop-gradient-guarded values:
+    assigned from ``stop_gradient(...)`` or from a ``dynamic_slice``
+    of an already-guarded local (the chunked wrappers' slicing idiom)."""
+    guarded: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for n in ast.walk(fn):
+            if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)):
+                continue
+            tgt = n.targets[0].id
+            if tgt in guarded:
+                continue
+            v = n.value
+            if _is_stop_gradient(v, mi):
+                guarded.add(tgt)
+                changed = True
+            elif (isinstance(v, ast.Call)
+                  and "dynamic_slice" in _qual(v.func, mi)
+                  and v.args and isinstance(v.args[0], ast.Name)
+                  and v.args[0].id in guarded):
+                guarded.add(tgt)
+                changed = True
+    return guarded
+
+
+class CotangentCompleteness(Rule):
+    id = "JL013"
+    title = "custom_vjp backward drops a primal cotangent"
+    report_only = False
+
+    def check(self, graph) -> Iterator[Finding]:
+        for mi in graph.modules.values():
+            if mi.tree is None:
+                continue
+            yield from self._check_module(mi)
+
+    def _check_module(self, mi: ModuleInfo) -> Iterator[Finding]:
+        fdefs = _collect_fdefs(mi.tree)
+        primals = _collect_primals(mi, fdefs)
+        if not primals:
+            return
+        caps = _capabilities(mi)
+        # enclosing TOP-LEVEL function of every call node, for the
+        # stop-gradient guard scan
+        guard_cache: Dict[int, Set[str]] = {}
+
+        def guards_for(fn: ast.FunctionDef) -> Set[str]:
+            if id(fn) not in guard_cache:
+                guard_cache[id(fn)] = _guarded_locals(fn, mi)
+            return guard_cache[id(fn)]
+
+        top_fns = [n for n in mi.tree.body
+                   if isinstance(n, ast.FunctionDef)]
+
+        for n in ast.walk(mi.tree):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "defvjp"
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id in primals):
+                continue
+            primal = primals[n.func.value.id]
+            if len(n.args) < 2 or not isinstance(n.args[1], ast.Name):
+                continue
+            bwd_cands = fdefs.get(n.args[1].id)
+            if not bwd_cands:
+                continue
+            bwd = bwd_cands[0]
+            yield from self._check_bwd(mi, primal, bwd, caps,
+                                       top_fns, guards_for)
+
+    def _check_bwd(self, mi, primal, bwd, caps, top_fns, guards_for,
+                   ) -> Iterator[Finding]:
+        if _always_raises(bwd):
+            return  # refusal route: no cotangent can silently flow
+        elts = _return_elts(bwd)
+        if elts is None:
+            return
+        if len(elts) != len(primal.diff_params):
+            yield self.finding(
+                mi, bwd,
+                "backward `%s` returns %d cotangents for %d "
+                "differentiable primal args of `%s`" % (
+                    bwd.name, len(elts), len(primal.diff_params),
+                    primal.name),
+                symbol=primal.name)
+            return
+        for param, pos, elt in zip(primal.diff_params, primal.diff_pos,
+                                   elts):
+            if not (isinstance(elt, ast.Constant) and elt.value is None):
+                continue
+            route = self._none_slot_route(
+                mi, primal, param, pos, caps, top_fns, guards_for)
+            if route == "ok":
+                continue
+            if route == "promised":
+                yield self.finding(
+                    mi, bwd,
+                    "capability flag promises a `%s` cotangent but "
+                    "backward `%s` of `%s` returns None for it" % (
+                        param, bwd.name, primal.name),
+                    symbol=primal.name)
+            else:
+                yield self.finding(
+                    mi, bwd,
+                    "backward `%s` returns None for differentiable "
+                    "primal arg `%s` of `%s` — produce a cotangent, "
+                    "stop_gradient-guard every call site, or declare "
+                    "a capability flag (<FLAG> = False plus "
+                    "<FLAG>_ARGS naming the arg)" % (
+                        bwd.name, param, primal.name),
+                    symbol=primal.name)
+
+    def _none_slot_route(self, mi, primal, param, pos, caps, top_fns,
+                         guards_for) -> str:
+        for _flag, value in caps.get(param, []):
+            if value is False:
+                return "ok"
+        if any(value is True for _f, value in caps.get(param, [])):
+            return "promised"
+        # stop-gradient route: every in-module call site guards the arg
+        sites: List[Tuple[ast.Call, ast.FunctionDef]] = []
+        for fn in top_fns:
+            if fn is primal.fdef:
+                continue
+            for c in ast.walk(fn):
+                if (isinstance(c, ast.Call)
+                        and isinstance(c.func, ast.Name)
+                        and c.func.id == primal.name):
+                    sites.append((c, fn))
+        if not sites:
+            return "violation"
+        for call, fn in sites:
+            arg = self._arg_at(call, pos, param)
+            if arg is None:
+                return "violation"
+            if _is_stop_gradient(arg, mi):
+                continue
+            if (isinstance(arg, ast.Name)
+                    and arg.id in guards_for(fn)):
+                continue
+            return "violation"
+        return "ok"
+
+    @staticmethod
+    def _arg_at(call: ast.Call, pos: int, param: str,
+                ) -> Optional[ast.expr]:
+        if pos < len(call.args):
+            a = call.args[pos]
+            return None if isinstance(a, ast.Starred) else a
+        for kw in call.keywords:
+            if kw.arg == param:
+                return kw.value
+        return None

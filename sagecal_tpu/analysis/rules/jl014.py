@@ -1,0 +1,193 @@
+"""JL014: precision flow in Pallas kernel bodies.
+
+The bf16 coherency knob (``coh_dtype="bf16"``) halves the dominant HBM
+stream but the numerics contract says *arithmetic stays f32*: every
+bf16-stored operand must be upcast at the point of load, and every
+matmul in a kernel body must pin its accumulator dtype.  Two silent
+ways to break that contract:
+
+- **missing upcast** — a kernel reads a bf16-ingested operand ref
+  (``ref[i, :]``) and feeds it straight into arithmetic.  The MXU will
+  happily accumulate at reduced precision and nothing fails — the
+  solver just converges somewhere slightly wrong.  The repo idiom is
+  ``_load_coh_planes``'s ``ref[...].astype(jnp.float32)`` at every
+  load site;
+- **unpinned matmul** — ``jnp.dot``/``jnp.matmul``/``lax.dot_general``
+  without ``preferred_element_type``.  On TPU the default accumulator
+  follows the operand dtype, so a bf16 operand silently flips the MXU
+  into bf16 accumulation.  The repo idiom is ``_sel_dot``'s explicit
+  ``preferred_element_type=jnp.float32``.
+
+Taint is traced package-wide: any name assigned from
+``.astype(jnp.bfloat16)`` anywhere in the package (the solver-side
+ingestion point, e.g. ``coh_ri`` in ``solvers/sage.py``) marks the
+kernel positional parameter it is passed to via ``pallas_call``, and
+propagates through module-local helper calls by position.  The matmul
+check covers every function reachable from a kernel body.
+
+Scope: modules that contain a ``pallas_call`` (currently
+``ops/rime_kernel.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set, Tuple
+
+from sagecal_tpu.analysis.engine import Finding, Rule
+from sagecal_tpu.analysis.callgraph import ModuleInfo, qual_of
+from sagecal_tpu.analysis.pallas import (
+    find_pallas_sites, kernel_names, kernel_reachable,
+    module_functions, positional_params)
+
+_DOT_LEAVES = ("dot", "matmul", "dot_general")
+
+
+def _qual(node: ast.AST, mi: ModuleInfo) -> str:
+    if not isinstance(node, (ast.Name, ast.Attribute)):
+        return ""
+    return qual_of(node, mi.imports, mi.toplevel, mi.name) or ""
+
+
+def _is_bf16_astype(expr: ast.AST, mi: ModuleInfo) -> bool:
+    """Any ``X.astype(<bfloat16>)`` call within the expression."""
+    for n in ast.walk(expr):
+        if (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "astype" and n.args
+                and _qual(n.args[0], mi).endswith("bfloat16")):
+            return True
+    return False
+
+
+def bf16_tainted_names(graph) -> Set[str]:
+    """Names assigned from ``.astype(jnp.bfloat16)`` anywhere in the
+    analyzed set — the bf16 ingestion points."""
+    out: Set[str] = set()
+    for mi in graph.modules.values():
+        if mi.tree is None:
+            continue
+        for n in ast.walk(mi.tree):
+            if not isinstance(n, ast.Assign):
+                continue
+            if not _is_bf16_astype(n.value, mi):
+                continue
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+class PrecisionFlow(Rule):
+    id = "JL014"
+    title = "bf16 operand read without upcast / unpinned matmul accumulator"
+    report_only = False
+
+    def check(self, graph) -> Iterator[Finding]:
+        tainted = bf16_tainted_names(graph)
+        for mi in graph.modules.values():
+            if mi.tree is None:
+                continue
+            sites = find_pallas_sites(mi)
+            if not sites:
+                continue
+            yield from self._check_module(mi, sites, tainted)
+
+    def _check_module(self, mi: ModuleInfo, sites, tainted: Set[str],
+                      ) -> Iterator[Finding]:
+        fns = module_functions(mi)
+        # seed (kernel, param) taint from pallas operand bindings
+        work: List[Tuple[str, str]] = []
+        seen: Set[Tuple[str, str]] = set()
+        for site in sites:
+            for b in site.bindings:
+                fn = fns.get(b.kernel_name)
+                if fn is None:
+                    continue
+                params = positional_params(fn)
+                for i, expr in enumerate(b.operand_exprs):
+                    if i >= len(params):
+                        break
+                    if (isinstance(expr, ast.Name)
+                            and expr.id in tainted):
+                        key = (b.kernel_name, params[i])
+                        if key not in seen:
+                            seen.add(key)
+                            work.append(key)
+        # propagate through module-local helper calls by position
+        idx = 0
+        while idx < len(work):
+            fname, pname = work[idx]
+            idx += 1
+            fn = fns.get(fname)
+            if fn is None:
+                continue
+            for n in ast.walk(fn):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Name)
+                        and n.func.id in fns):
+                    continue
+                callee = fns[n.func.id]
+                cparams = positional_params(callee)
+                for j, a in enumerate(n.args):
+                    if (isinstance(a, ast.Name) and a.id == pname
+                            and j < len(cparams)):
+                        key = (n.func.id, cparams[j])
+                        if key not in seen:
+                            seen.add(key)
+                            work.append(key)
+        # (a) every Load subscript of a tainted ref must be upcast
+        for fname, pname in seen:
+            fn = fns.get(fname)
+            if fn is None:
+                continue
+            yield from self._check_upcasts(mi, fn, pname)
+        # (b) every matmul reachable from a kernel body pins its
+        # accumulator
+        reach = kernel_reachable(mi, kernel_names(sites))
+        for fname in sorted(reach):
+            yield from self._check_dots(mi, fns[fname])
+
+    def _check_upcasts(self, mi: ModuleInfo, fn: ast.FunctionDef,
+                       pname: str) -> Iterator[Finding]:
+        wrapped: Set[int] = set()
+        for n in ast.walk(fn):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "astype" and n.args
+                    and _qual(n.args[0], mi).endswith("float32")):
+                wrapped.add(id(n.func.value))
+        for n in ast.walk(fn):
+            if (isinstance(n, ast.Subscript)
+                    and isinstance(n.ctx, ast.Load)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == pname
+                    and id(n) not in wrapped):
+                yield self.finding(
+                    mi, n,
+                    "bf16-ingested operand `%s` read in `%s` without "
+                    "`.astype(jnp.float32)` — the bf16 knob halves "
+                    "HBM traffic, not arithmetic precision; upcast "
+                    "at the load" % (pname, fn.name),
+                    symbol=fn.name)
+
+    def _check_dots(self, mi: ModuleInfo, fn: ast.FunctionDef,
+                    ) -> Iterator[Finding]:
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            q = _qual(n.func, mi)
+            leaf = q.rsplit(".", 1)[-1] if q else ""
+            if leaf not in _DOT_LEAVES or not (
+                    q.startswith("jax.") or q.startswith("jnp.")):
+                continue
+            if any(kw.arg == "preferred_element_type"
+                   for kw in n.keywords):
+                continue
+            yield self.finding(
+                mi, n,
+                "`%s` in kernel scope `%s` without "
+                "preferred_element_type — a bf16 operand silently "
+                "flips MXU accumulation to bf16; pin f32" % (
+                    leaf, fn.name),
+                symbol=fn.name)

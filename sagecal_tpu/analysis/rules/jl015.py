@@ -1,0 +1,93 @@
+"""JL015: BlockSpec/grid hazards.
+
+Pallas BlockSpec mistakes fail late and badly: an ``index_map`` whose
+return rank disagrees with the block-shape rank is a Mosaic lowering
+error on hardware (invisible on CPU interpret mode), and an operand
+without an explicit ``memory_space`` gets backend-dependent default
+placement — the repo's VMEM budget model (analysis/kernelmodel.py)
+can only account for operands whose placement is declared.  Both are
+statically decidable from the call expression, so this rule proves
+them at commit time:
+
+- **rank mismatch** — ``pl.BlockSpec((1, T), lambda r: (0, 0, r))``:
+  a literal block-shape tuple whose length differs from the number of
+  indices the ``index_map`` lambda returns;
+- **missing memory_space** — a ``BlockSpec`` without an explicit
+  ``memory_space=`` keyword.  The repo idiom pins every operand
+  (``pltpu.TPUMemorySpace.ANY``/VMEM/SMEM) so the footprint model and
+  the code agree on residency.
+
+The *numeric* grid hazards (a grid axis that does not cover the padded
+row extent, block x steps != extent) need shape arithmetic, which the
+symbolic interpreter in ``analysis/kernelmodel.py`` performs — those
+are reported by ``diag kernelcheck`` as ``grid-coverage`` violations
+rather than by this AST-local rule.
+
+Scope: modules importing ``jax.experimental.pallas`` (or ``.tpu``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from sagecal_tpu.analysis.engine import Finding, Rule
+from sagecal_tpu.analysis.callgraph import ModuleInfo, qual_of
+from sagecal_tpu.analysis.pallas import is_pallas_module
+
+
+def _qual(node: ast.AST, mi: ModuleInfo) -> str:
+    if not isinstance(node, (ast.Name, ast.Attribute)):
+        return ""
+    return qual_of(node, mi.imports, mi.toplevel, mi.name) or ""
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class BlockSpecHazard(Rule):
+    id = "JL015"
+    title = "BlockSpec rank mismatch / unspecified memory space"
+    report_only = False
+
+    def check(self, graph) -> Iterator[Finding]:
+        for mi in graph.modules.values():
+            if mi.tree is None or not is_pallas_module(mi):
+                continue
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not _qual(node.func, mi).endswith(".BlockSpec"):
+                    continue
+                yield from self._check_spec(mi, node)
+
+    def _check_spec(self, mi: ModuleInfo, node: ast.Call,
+                    ) -> Iterator[Finding]:
+        fi = mi.enclosing_function(node)
+        sym = fi.qualname if fi else ""
+        block = node.args[0] if node.args else _kwarg(node, "block_shape")
+        index_map = (node.args[1] if len(node.args) > 1
+                     else _kwarg(node, "index_map"))
+        if (isinstance(block, ast.Tuple)
+                and isinstance(index_map, ast.Lambda)):
+            brank = len(block.elts)
+            body = index_map.body
+            irank = len(body.elts) if isinstance(body, ast.Tuple) else 1
+            if brank != irank:
+                yield self.finding(
+                    mi, node,
+                    "index_map returns %d indices for a rank-%d "
+                    "block shape — Mosaic rejects this at lowering, "
+                    "on hardware only" % (irank, brank),
+                    symbol=sym)
+        if _kwarg(node, "memory_space") is None:
+            yield self.finding(
+                mi, node,
+                "BlockSpec without explicit memory_space — default "
+                "placement is backend-dependent and invisible to the "
+                "VMEM budget model; declare VMEM/SMEM/ANY",
+                symbol=sym)

@@ -52,10 +52,17 @@ Subcommands:
   degradation (station outliers, heavy down-weighting).
 
 - ``lint [paths...] [--format json|text] [--baseline FILE]`` — the
-  jaxlint static-analysis gate (:mod:`sagecal_tpu.analysis`): JL001-
-  JL006 JAX-discipline rules + the report-only JL900 dead-import sweep
-  over the given paths (default: the installed ``sagecal_tpu``).
-  Exit 1 on new (non-baselined) findings.
+  jaxlint static-analysis gate (:mod:`sagecal_tpu.analysis`): the
+  JL001-JL015 JAX/kernel-discipline rules + the report-only JL900
+  dead-import sweep over the given paths (default: the installed
+  ``sagecal_tpu``).  Exit 1 on new (non-baselined) findings.
+
+- ``kernelcheck [--json] [--crosscheck] [--backend B]`` — the kernel
+  contract checker (:mod:`sagecal_tpu.analysis.kernel_check`): proves
+  the Pallas grids' VMEM budgets (``FULL_CLUSTER_TILE``,
+  ``_BATCH_ROWS_MAX``), grid coverage, the banked
+  ``KERNEL_VMEM_TABLE.json`` freshness, and the JL013-JL015 kernel
+  lints.  Exit 1 on any violation.
 
 - ``trace FILE [--chrome OUT] [--straggler-ratio R]`` — span-tree
   report from a ``SAGECAL_TRACE=1`` run's span JSONL: tree, per-name
@@ -899,6 +906,14 @@ def _cmd_lint(args) -> int:
     return lint_main(args.lint_args)
 
 
+def _cmd_kernelcheck(args) -> int:
+    # lazy: the checker is stdlib-only unless --crosscheck asks for a
+    # compiled memory_analysis() comparison (which imports jax)
+    from sagecal_tpu.analysis.kernel_check import main as kc_main
+
+    return kc_main(args.kernelcheck_args)
+
+
 def _cmd_protocol(args) -> int:
     """Exhaustively model-check the fleet lease/stream protocols
     (real queue + owner-lease code over the simulated fs).  Exit 0
@@ -1108,13 +1123,24 @@ def build_parser() -> argparse.ArgumentParser:
 
     lp = sub.add_parser(
         "lint",
-        help="jaxlint static-analysis gate (JL001-JL011 + JL900)",
+        help="jaxlint static-analysis gate (JL001-JL015 + JL900)",
     )
     lp.add_argument("lint_args", nargs=argparse.REMAINDER,
                     help="arguments forwarded to jaxlint "
                          "(paths, --format, --baseline, --rules, ...); "
                          "default lints the installed sagecal_tpu")
     lp.set_defaults(fn=_cmd_lint)
+
+    kcp = sub.add_parser(
+        "kernelcheck",
+        help="kernel contract checker: VMEM budgets, grid coverage, "
+             "table freshness, JL013-JL015 (exit 1 on violation)",
+    )
+    kcp.add_argument("kernelcheck_args", nargs=argparse.REMAINDER,
+                     help="arguments forwarded to kernel_check "
+                          "(--json, --crosscheck, --backend, --table, "
+                          "--no-table-check)")
+    kcp.set_defaults(fn=_cmd_kernelcheck)
 
     pcp = sub.add_parser(
         "protocol",
@@ -1137,6 +1163,16 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    # argparse REMAINDER cannot capture a leading option (bpo-17050:
+    # `diag lint --format json ...` dies in the TOP-level parser), so
+    # the pass-through subcommands forward by hand
+    if argv and argv[0] == "lint":
+        from sagecal_tpu.analysis.cli import main as lint_main
+        return lint_main(argv[1:])
+    if argv and argv[0] == "kernelcheck":
+        from sagecal_tpu.analysis.kernel_check import main as kc_main
+        return kc_main(argv[1:])
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

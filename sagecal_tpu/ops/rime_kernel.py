@@ -447,6 +447,14 @@ def _fused_predict_bwd_impl(tab_re, tab_im, coh_ri, ant_p, ant_q, g_ri,
 # sky_constant() instead of silently returning zeros.
 FUSED_COHERENCY_COTANGENT = False
 
+# Machine-checkable form of the same contract: the argument(s) whose
+# cotangent the capability flag governs.  jaxlint's JL013
+# (cotangent-completeness) accepts a None cotangent slot for any
+# custom_vjp argument named here while the flag is False, and reports
+# the pair as a broken promise if the flag is ever flipped True without
+# the backward actually producing the cotangent.
+FUSED_COHERENCY_COTANGENT_ARGS = ("coh_ri",)
+
 
 class FusedSkyGradientError(NotImplementedError):
     """A caller requested coherency (sky-parameter) gradients through
@@ -608,6 +616,11 @@ def fused_predict_packed_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
     # sky_constant guard (raise on coherency cotangent, not silent
     # zeros) keeps the plan-None and chunked paths identical
     coh_ri = sky_constant(coh_ri)
+    # antenna index maps are integer data constants: stop_gradient is
+    # the identity on them, and makes the backward's None cotangent
+    # slots statically provable (JL013) — no cotangent ever requested
+    ant_p = jax.lax.stop_gradient(ant_p)
+    ant_q = jax.lax.stop_gradient(ant_q)
     if plan is None:
         return fused_predict_packed(tab_re, tab_im, coh_ri,
                                     ant_p, ant_q, tile)
@@ -632,6 +645,10 @@ def fused_predict_packed_hybrid_chunked(tab_re, tab_im, coh_ri, ant_p,
     _, F, _, rowsp = coh_ri.shape
     plan = _chunk_plan(rowsp, tile, max_rows)
     coh_ri = sky_constant(coh_ri)
+    # integer data constants (see fused_predict_packed_chunked)
+    ant_p = jax.lax.stop_gradient(ant_p)
+    ant_q = jax.lax.stop_gradient(ant_q)
+    cmap = jax.lax.stop_gradient(cmap)
     if plan is None:
         return fused_predict_packed_hybrid(
             tab_re, tab_im, coh_ri, ant_p, ant_q, cmap, nc, tile)
@@ -971,8 +988,16 @@ def fused_cost_packed(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri,
     backward kernel that never materializes the model or residual in
     HBM."""
     robust = nu is not None
+    # data constants of the solve: stop_gradient (identity for values)
+    # makes the backward's None cotangent slots statically provable
+    # (JL013) — differentiation w.r.t. these args is never requested
+    ant_p = jax.lax.stop_gradient(ant_p)
+    ant_q = jax.lax.stop_gradient(ant_q)
+    vis_ri = jax.lax.stop_gradient(vis_ri)
+    mask_p = jax.lax.stop_gradient(mask_p)
+    nu_arr = jax.lax.stop_gradient(_nu_cell(nu))
     return _fused_cost(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri,
-                       mask_p, _nu_cell(nu), robust, tile)
+                       mask_p, nu_arr, robust, tile)
 
 
 def fused_cost_packed_hybrid(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri,
@@ -980,8 +1005,15 @@ def fused_cost_packed_hybrid(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri,
     """Hybrid-chunk (nc > 1) objective: tables carry one row block per
     (cluster, chunk), ``cmap`` (Mp, rowsp) selects each row's chunk."""
     robust = nu is not None
+    # data constants of the solve (see fused_cost_packed)
+    ant_p = jax.lax.stop_gradient(ant_p)
+    ant_q = jax.lax.stop_gradient(ant_q)
+    vis_ri = jax.lax.stop_gradient(vis_ri)
+    mask_p = jax.lax.stop_gradient(mask_p)
+    cmap = jax.lax.stop_gradient(cmap)
+    nu_arr = jax.lax.stop_gradient(_nu_cell(nu))
     return _fused_cost_hybrid(tab_re, tab_im, coh_ri, ant_p, ant_q,
-                              vis_ri, mask_p, _nu_cell(nu), cmap, nc,
+                              vis_ri, mask_p, nu_arr, cmap, nc,
                               robust, tile)
 
 
@@ -997,9 +1029,12 @@ def fused_cost_packed_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
     the predict wrappers — never silent zeros)."""
     _, F, _, rowsp = coh_ri.shape
     plan = _chunk_plan(rowsp, tile, max_rows)
-    nu_arr = _nu_cell(nu)
+    nu_arr = jax.lax.stop_gradient(_nu_cell(nu))
     robust = nu is not None
     coh_ri = sky_constant(coh_ri)
+    # integer data constants (see fused_cost_packed)
+    ant_p = jax.lax.stop_gradient(ant_p)
+    ant_q = jax.lax.stop_gradient(ant_q)
     if plan is None:
         return _fused_cost(tab_re, tab_im, coh_ri,
                            ant_p, ant_q, jax.lax.stop_gradient(vis_ri),
@@ -1027,9 +1062,13 @@ def fused_cost_packed_hybrid_chunked(tab_re, tab_im, coh_ri, ant_p, ant_q,
     """Hybrid-chunk (nc > 1) analog of fused_cost_packed_chunked."""
     _, F, _, rowsp = coh_ri.shape
     plan = _chunk_plan(rowsp, tile, max_rows)
-    nu_arr = _nu_cell(nu)
+    nu_arr = jax.lax.stop_gradient(_nu_cell(nu))
     robust = nu is not None
     coh_ri = sky_constant(coh_ri)
+    # integer data constants (see fused_cost_packed)
+    ant_p = jax.lax.stop_gradient(ant_p)
+    ant_q = jax.lax.stop_gradient(ant_q)
+    cmap = jax.lax.stop_gradient(cmap)
     if plan is None:
         return _fused_cost_hybrid(
             tab_re, tab_im, coh_ri, ant_p, ant_q,
@@ -1320,9 +1359,12 @@ def fused_cost_packed_batch(tab_re, tab_im, coh_ri, ant_p, ant_q, vis_ri,
     B = vis_ri.shape[0]
     rowsp = coh_ri.shape[-1]
     plan = _chunk_plan(rowsp, tile, max_rows)
-    nu_arr = _nu_rows(nu, B)
+    nu_arr = jax.lax.stop_gradient(_nu_rows(nu, B))
     robust = nu is not None
     coh_ri = sky_constant(coh_ri)
+    # integer data constants (see fused_cost_packed)
+    ant_p = jax.lax.stop_gradient(ant_p)
+    ant_q = jax.lax.stop_gradient(ant_q)
     if plan is None:
         return _fused_cost_batch(
             tab_re, tab_im, coh_ri, ant_p, ant_q,

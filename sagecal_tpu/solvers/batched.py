@@ -32,6 +32,8 @@ bit-identical, because batched reductions may re-associate.
 
 from __future__ import annotations
 
+import json
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -55,8 +57,74 @@ from sagecal_tpu.solvers.sage import (
 # accumulators are sixteen (B*Mp, tile) f32 planes, so B*Mp is bounded
 # exactly like the solo kernel's padded cluster count at tile 128 (the
 # hardware-proven FULL_CLUSTER_TILE configuration — ops/rime_kernel.py
-# batched section comment).
+# batched section comment).  LAST-RESORT fallback only: the live bound
+# comes from the banked VMEM table (KERNEL_VMEM_TABLE.json, regenerated
+# by tools/kernel_vmem_table.py from the symbolic footprint model) via
+# :func:`batch_rows_bound` — the model admits MORE rows for bf16
+# coherencies (the bf16 operand block halves) where this constant is
+# the conservative f32 value.
 _BATCH_ROWS_MAX = 104
+
+# (path, mtime) -> parsed table; the serve path calls
+# choose_batched_path per bucket, so the table read must not be a
+# per-call disk hit
+_TABLE_CACHE: dict = {}
+
+
+def _vmem_table_path() -> str:
+    override = os.environ.get("SAGECAL_KERNEL_VMEM_TABLE")
+    if override:
+        return override
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "KERNEL_VMEM_TABLE.json")
+
+
+def _load_vmem_table():
+    path = _vmem_table_path()
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    key = (path, mtime)
+    if _TABLE_CACHE.get("key") == key:
+        return _TABLE_CACHE["table"]
+    try:
+        with open(path, "r") as fh:
+            table = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    _TABLE_CACHE["key"] = key
+    _TABLE_CACHE["table"] = table
+    return table
+
+
+def batch_rows_bound(coh_dtype: str = "f32",
+                     tile: Optional[int] = None) -> int:
+    """Row bound (B*Mp) of the batched fused backward kernel.
+
+    Resolution order: ``$SAGECAL_KERNEL_VMEM_TABLE`` / the banked
+    repo-root ``KERNEL_VMEM_TABLE.json`` (written by
+    ``tools/kernel_vmem_table.py``), then a live
+    :mod:`sagecal_tpu.analysis.kernelmodel` computation, then the
+    hardware-proven f32 constant.  ``coh_dtype="bf16"`` legitimately
+    admits more rows than f32 — the coherency VMEM block halves."""
+    table = _load_vmem_table()
+    if table is not None:
+        try:
+            t = tile if tile is not None else int(
+                table["constants"]["FULL_CLUSTER_TILE"])
+            return int(table["batch_rows_max"][coh_dtype][str(t)])
+        except (KeyError, TypeError, ValueError):
+            pass
+    try:
+        from sagecal_tpu.analysis.kernelmodel import load_model
+        from sagecal_tpu.ops.rime_kernel import FULL_CLUSTER_TILE
+        model = load_model()
+        return int(model.batch_rows_max(
+            tile if tile is not None else FULL_CLUSTER_TILE, coh_dtype))
+    except Exception:
+        return _BATCH_ROWS_MAX
 
 
 def _batch_axes(tree):
@@ -113,10 +181,12 @@ def choose_batched_path(data, cdata, p0, config: SageConfig):
     ant_q = np.asarray(data.ant_q)
     if not (np.all(ant_p == ant_p[:1]) and np.all(ant_q == ant_q[:1])):
         return "fused", "lanes do not share baseline geometry"
-    if B * pad_to(M, 8) > _BATCH_ROWS_MAX:
+    rows_max = batch_rows_bound(coh_dtype=config.coh_dtype)
+    if B * pad_to(M, 8) > rows_max:
         return "fused", (
             f"B*Mp={B * pad_to(M, 8)} exceeds the backward kernel's "
-            f"VMEM accumulator bound ({_BATCH_ROWS_MAX})")
+            f"VMEM accumulator bound ({rows_max}, "
+            f"coh_dtype={config.coh_dtype})")
     return "fused_batch", "all batched-kernel capability checks passed"
 
 

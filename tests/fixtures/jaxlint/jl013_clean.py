@@ -1,0 +1,72 @@
+"""JL013 clean fixture: every None cotangent slot takes a declared
+route — capability flag, stop-gradient-guarded call sites (including
+the dynamic-slice passthrough), or an unconditionally-raising backward.
+"""
+
+import jax
+import jax.numpy as jnp
+
+CAP_FLAG = False
+CAP_FLAG_ARGS = ("coh",)
+
+
+@jax.custom_vjp
+def cap_declared(x, coh):
+    return x * coh
+
+
+def _cd_fwd(x, coh):
+    return x * coh, coh
+
+
+def _cd_bwd(res, g):
+    return g * res, None  # declared via CAP_FLAG / CAP_FLAG_ARGS
+
+
+cap_declared.defvjp(_cd_fwd, _cd_bwd)
+
+
+@jax.custom_vjp
+def guarded(x, idx):
+    return x + idx
+
+
+def _g_fwd(x, idx):
+    return x + idx, None
+
+
+def _g_bwd(res, g):
+    return g, None  # every call site stop-gradient-guards idx
+
+
+guarded.defvjp(_g_fwd, _g_bwd)
+
+
+def call_guarded_direct(x, idx):
+    return guarded(x, jax.lax.stop_gradient(idx))
+
+
+def call_guarded_sliced(x, idx):
+    idx = jax.lax.stop_gradient(idx)
+    chunk = jax.lax.dynamic_slice_in_dim(idx, 0, 4, axis=0)
+    return guarded(x, chunk)
+
+
+@jax.custom_vjp
+def refuses(x):
+    return x
+
+
+def _r_fwd(x):
+    return x, None
+
+
+def _r_bwd(res, g):
+    raise NotImplementedError("no cotangent by explicit contract")
+
+
+refuses.defvjp(_r_fwd, _r_bwd)
+
+
+def total(x, w):
+    return jnp.sum(x) + jnp.sum(w)

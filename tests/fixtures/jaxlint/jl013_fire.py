@@ -1,0 +1,73 @@
+"""JL013 fire fixture: custom_vjp backwards that drop cotangents.
+
+Three distinct violations: a silent-None slot with an unguarded call
+site, a backward whose return arity misses a differentiable arg, and a
+capability flag that PROMISES a cotangent the backward never produces.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.custom_vjp
+def silent_zero(x, w):
+    return x * w
+
+
+def _sz_fwd(x, w):
+    return x * w, (x, w)
+
+
+def _sz_bwd(res, g):
+    x, w = res
+    return g * w, None  # FIRE: drops w's cotangent silently
+
+
+silent_zero.defvjp(_sz_fwd, _sz_bwd)
+
+
+def caller(x, w):
+    # unguarded call site: w's None slot is a live zero-gradient trap
+    return silent_zero(x, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def misaligned(a, b, flag):
+    return a + b
+
+
+def _ma_fwd(a, b, flag):
+    return a + b, None
+
+
+def _ma_bwd(flag, res, g):
+    return (g,)  # FIRE: two differentiable args, one cotangent
+
+
+misaligned.defvjp(_ma_fwd, _ma_bwd)
+
+
+HAS_THETA_COTANGENT = True
+HAS_THETA_COTANGENT_ARGS = ("theta",)
+
+
+@jax.custom_vjp
+def promised(x, theta):
+    return x * theta
+
+
+def _p_fwd(x, theta):
+    return x * theta, theta
+
+
+def _p_bwd(res, g):
+    return g * res, None  # FIRE: the flag above promises a cotangent
+
+
+promised.defvjp(_p_fwd, _p_bwd)
+
+
+def use_promised(x, theta):
+    return jnp.sum(promised(x, theta))

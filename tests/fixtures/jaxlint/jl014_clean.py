@@ -1,0 +1,40 @@
+"""JL014 clean fixture: every bf16-ingested read upcasts at the load
+and every kernel matmul pins its accumulator dtype."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _helper(coh_ref):
+    return coh_ref[1, :].astype(jnp.float32)
+
+
+def _kernel(coh_ref, w_ref, out_ref):
+    a = coh_ref[0, :].astype(jnp.float32)
+    b = _helper(coh_ref)
+    sel = jnp.dot(w_ref[0, :], w_ref[1, :],
+                  preferred_element_type=jnp.float32)
+    out_ref[0, :] = a + b + sel
+
+
+def run(coh, w):
+    coh_ri = coh.astype(jnp.bfloat16)
+    kernel = functools.partial(_kernel)
+    args = (coh_ri, w)
+    return pl.pallas_call(
+        kernel,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((2, 128), lambda r: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, 128), lambda r: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 128), lambda r: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 128), jnp.float32),
+    )(*args)

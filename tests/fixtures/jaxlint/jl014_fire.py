@@ -1,0 +1,40 @@
+"""JL014 fire fixture: bf16-ingested kernel operand read without an
+f32 upcast (directly and through a helper the taint propagates into),
+plus a matmul without a pinned accumulator dtype."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _helper(coh_ref):
+    return coh_ref[1, :]  # FIRE: propagated bf16 ref, no upcast
+
+
+def _kernel(coh_ref, w_ref, out_ref):
+    a = coh_ref[0, :]  # FIRE: bf16 read, no upcast
+    b = _helper(coh_ref)
+    sel = jnp.dot(w_ref[0, :], w_ref[1, :])  # FIRE: unpinned accumulator
+    out_ref[0, :] = a + b + sel
+
+
+def run(coh, w):
+    coh_ri = coh.astype(jnp.bfloat16)
+    kernel = functools.partial(_kernel)
+    args = (coh_ri, w)
+    return pl.pallas_call(
+        kernel,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((2, 128), lambda r: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, 128), lambda r: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 128), lambda r: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 128), jnp.float32),
+    )(*args)

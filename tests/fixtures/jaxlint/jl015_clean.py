@@ -1,0 +1,30 @@
+"""JL015 clean fixture: every BlockSpec carries a rank-consistent
+index_map and an explicit memory_space."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def run(x):
+    return pl.pallas_call(
+        _kernel,
+        grid=(4,),
+        in_specs=[
+            pl.BlockSpec((1, 128), lambda r: (0, r),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 128), lambda r: (0, r),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 512), jnp.float32),
+    )(x)
+
+
+def row_spec(tile):
+    return pl.BlockSpec((1, tile), lambda r: (0, r),
+                        memory_space=pltpu.SMEM)

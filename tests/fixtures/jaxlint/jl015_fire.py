@@ -1,0 +1,32 @@
+"""JL015 fire fixture: BlockSpec hazards — an index_map whose return
+rank disagrees with the block shape, and operands without an explicit
+memory_space."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def run(x):
+    return pl.pallas_call(
+        _kernel,
+        grid=(4,),
+        in_specs=[
+            # FIRE: 3 indices for a rank-2 block
+            pl.BlockSpec((1, 128), lambda r: (0, 0, r),
+                         memory_space=pltpu.VMEM),
+        ],
+        # FIRE: no memory_space
+        out_specs=pl.BlockSpec((1, 128), lambda r: (0, r)),
+        out_shape=jax.ShapeDtypeStruct((1, 512), jnp.float32),
+    )(x)
+
+
+def row_spec(tile):
+    # FIRE: no memory_space
+    return pl.BlockSpec((1, tile), lambda r: (0, r))

@@ -66,6 +66,9 @@ FIRE_CASES = [
     ("JL010", os.path.join("fleet", "jl010_fire.py"), 2),
     ("JL011", "jl011_fire.py", 2),
     ("JL012", os.path.join("solvers", "jl012_fire.py"), 3),
+    ("JL013", "jl013_fire.py", 3),
+    ("JL014", "jl014_fire.py", 3),
+    ("JL015", "jl015_fire.py", 3),
     ("JL900", "jl900_fixture.py", 2),
 ]
 
@@ -81,6 +84,9 @@ CLEAN_CASES = [
     ("JL010", os.path.join("fleet", "jl010_clean.py")),
     ("JL011", "jl011_clean.py"),
     ("JL012", os.path.join("solvers", "jl012_clean.py")),
+    ("JL013", "jl013_clean.py"),
+    ("JL014", "jl014_clean.py"),
+    ("JL015", "jl015_clean.py"),
 ]
 
 
@@ -247,7 +253,8 @@ class TestCLI:
         out = capsys.readouterr().out
         for rid in ("JL001", "JL002", "JL003", "JL004", "JL005",
                     "JL006", "JL007", "JL008", "JL009", "JL010",
-                    "JL011", "JL012", "JL900"):
+                    "JL011", "JL012", "JL013", "JL014", "JL015",
+                    "JL900"):
             assert rid in out
         assert "report-only" in out
 

@@ -1,0 +1,306 @@
+"""Kernel contract checker tests: the symbolic VMEM model, the banked
+KERNEL_VMEM_TABLE.json, the batched-path bound plumbing, and the
+seeded-mutation kit proving each contract check actually detects the
+regression class it was built for.
+
+All model numbers asserted here are PINS: they were derived from the
+shipped ``sagecal_tpu/ops/rime_kernel.py`` and cross-checked against
+jax's own ``memory_analysis()`` on CPU (operand bytes match the
+compiled executable exactly).  If one moves, either the kernel changed
+(regenerate the table via ``tools/kernel_vmem_table.py``) or the model
+extraction broke — neither should pass silently.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from sagecal_tpu.analysis import kernel_check as kc
+from sagecal_tpu.analysis import kernelmodel as km
+
+pytestmark = pytest.mark.kernelcheck
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TABLE = os.path.join(REPO, "KERNEL_VMEM_TABLE.json")
+TOOL = os.path.join(REPO, "tools", "kernel_vmem_table.py")
+
+
+@pytest.fixture(scope="module")
+def model():
+    return km.load_model()
+
+
+# ------------------------------------------------------------ extraction
+
+
+class TestModelExtraction:
+    def test_census_counts(self, model):
+        # structural census of the real kernel source: selection masks,
+        # coherency loads, conjugation products, J.A accumulators, ...
+        assert model.counts == {
+            "sel_planes": 8,
+            "load_planes": 8,
+            "cjqh_planes": 8,
+            "jpa_planes": 8,
+            "acc_zeros": 16,
+            "da_planes": 8,
+            "lane_bcast_planes": 8,
+            "onehot_planes": 2,
+        }
+
+    def test_census_per_family(self, model):
+        F = 2
+        fp = model.footprint("predict_fwd",
+                             km.KernelConfig(Mp=104, F=F, tile=128))
+        assert fp.census == 64
+        fp = model.footprint("predict_bwd",
+                             km.KernelConfig(Mp=104, F=F, tile=128))
+        assert fp.census == 112
+        fp = model.footprint("cost_bwd",
+                             km.KernelConfig(Mp=104, F=F, tile=128))
+        assert fp.census == 128
+        # hybrid adds nc chunk-selector masks + reshaped selections
+        fp = model.footprint("cost_bwd",
+                             km.KernelConfig(Mp=104, F=F, tile=128, nc=2))
+        assert fp.census == 138
+        fp = model.footprint("cost_batch_bwd",
+                             km.KernelConfig(Mp=8, B=13, F=F, tile=128))
+        assert fp.census == 144
+
+    def test_calibration_factors(self, model):
+        f = model.factors()
+        assert f["fwd"] == pytest.approx(1.0206791114734357, rel=1e-9)
+        assert f["bwd"] == pytest.approx(1.2529689319681676, rel=1e-9)
+
+
+# ------------------------------------------------------- derived bounds
+
+
+class TestDerivedBounds:
+    def test_full_cluster_tile_matches_shipped(self, model):
+        assert model.consts["FULL_CLUSTER_TILE"] == 128
+        assert model.derived_full_cluster_tile() == 128
+
+    def test_feasible_tile_truth_table(self, model):
+        ft = model.feasible_tiles()
+        expect = {
+            "predict_fwd": {128: True, 256: True, 512: False},
+            "predict_bwd": {128: True, 256: False},
+            "cost_bwd": {128: True, 256: False},
+            "cost_batch_bwd": {128: True, 256: False},
+        }
+        for fam, row in expect.items():
+            for tile, ok in row.items():
+                assert ft[fam][tile]["feasible"] is ok, (fam, tile)
+        # every family clears the v5e ceiling at tile 64 and 128
+        for fam in km.FAMILIES:
+            assert ft[fam][64]["feasible"] and ft[fam][128]["feasible"]
+
+    def test_footprint_mib_pins(self, model):
+        cfg = km.KernelConfig(Mp=104, F=2, tile=128)
+        assert model.footprint("predict_fwd", cfg).mib == pytest.approx(
+            5.49, abs=0.02)
+        assert model.footprint("predict_bwd", cfg).mib == pytest.approx(
+            9.71, abs=0.02)
+        assert model.footprint("cost_bwd", cfg).mib == pytest.approx(
+            10.73, abs=0.02)
+        bcfg = km.KernelConfig(Mp=8, B=13, F=2, tile=128)
+        assert model.footprint("cost_batch_bwd", bcfg).mib == pytest.approx(
+            12.09, abs=0.02)
+
+    def test_batch_rows_max_pins(self, model):
+        f32 = {t: model.batch_rows_max(t, "f32") for t in km.SWEEP_TILES}
+        bf16 = {t: model.batch_rows_max(t, "bf16") for t in km.SWEEP_TILES}
+        assert f32 == {64: 195, 128: 104, 256: 53, 512: 26}
+        assert bf16 == {64: 208, 128: 111, 256: 57, 512: 28}
+
+    def test_batch_rows_bound_shape(self, model):
+        # bf16 halves the coherency block, so it always admits at least
+        # as many rows; larger tiles always admit fewer
+        for t in km.SWEEP_TILES:
+            assert model.batch_rows_max(t, "bf16") >= \
+                model.batch_rows_max(t, "f32")
+        f32 = [model.batch_rows_max(t, "f32") for t in km.SWEEP_TILES]
+        assert f32 == sorted(f32, reverse=True)
+
+    def test_bound_rows_actually_fit(self, model):
+        # the bound is min(hardware-proven envelope, ceiling inversion):
+        # at tile 128/f32 the envelope binds EXACTLY (104 rows is the
+        # largest shape proven on hardware, ~13.2 MiB — conservatively
+        # below the 16 MiB ceiling); everywhere the model claims rows,
+        # the modeled footprint must clear the ceiling
+        assert model.batch_rows_max(128, "f32") == \
+            km.PROVEN_BATCH_ENVELOPE["rows"]
+        ceiling = km.CEILINGS[km.DEFAULT_BACKEND]
+        for dt in ("f32", "bf16"):
+            for tile in km.SWEEP_TILES:
+                rows = model.batch_rows_max(tile, dt)
+                fp = model.footprint("cost_batch_bwd", km.KernelConfig(
+                    Mp=8, B=max(1, rows // 8), F=2, tile=tile,
+                    coh_dtype=dt))
+                assert fp.total_bytes <= ceiling, (dt, tile)
+
+
+# ------------------------------------------------------------ the table
+
+
+class TestVmemTable:
+    def test_banked_table_is_fresh(self, model):
+        with open(TABLE) as fh:
+            banked = json.load(fh)
+        assert banked == model.build_table()
+
+    def test_tool_roundtrip_and_staleness(self, tmp_path):
+        out = str(tmp_path / "table.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run([sys.executable, TOOL, "--out", out],
+                           capture_output=True, text=True, env=env)
+        assert r.returncode == 0, r.stderr
+        r = subprocess.run([sys.executable, TOOL, "--out", out, "--check"],
+                           capture_output=True, text=True, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+        # tamper -> stale, and --check must not rewrite the file
+        data = json.loads(open(out).read())
+        data["batch_rows_max"]["f32"]["128"] = 999
+        with open(out, "w") as fh:
+            json.dump(data, fh)
+        before = open(out).read()
+        r = subprocess.run([sys.executable, TOOL, "--out", out, "--check"],
+                           capture_output=True, text=True, env=env)
+        assert r.returncode == 1
+        assert open(out).read() == before
+
+    def test_choose_batched_path_reads_the_table(self, tmp_path,
+                                                 monkeypatch, model):
+        from sagecal_tpu.solvers.batched import (
+            batch_rows_bound, choose_batched_path,
+        )
+        from sagecal_tpu.solvers.sage import SageConfig
+
+        assert batch_rows_bound() == 104
+        assert batch_rows_bound(coh_dtype="bf16") == 111
+
+        B, M = 2, 64  # B*Mp = 128 rows: over the proven 104-row bound
+        data = types.SimpleNamespace(
+            ant_p=np.zeros((B, 6), np.int32),
+            ant_q=np.ones((B, 6), np.int32))
+        p0 = np.zeros((B, M, 1, 8 * 8), np.float32)
+        cfg = SageConfig(use_fused_predict=True)
+
+        path, reason = choose_batched_path(data, None, p0, cfg)
+        assert path == "fused"
+        assert "104" in reason
+
+        # a doctored table (say, a future larger-VMEM part) flips the
+        # routing decision without touching solver code
+        doctored = dict(model.build_table())
+        doctored["batch_rows_max"] = {
+            "f32": {"128": 200}, "bf16": {"128": 220}}
+        tpath = str(tmp_path / "doctored.json")
+        with open(tpath, "w") as fh:
+            json.dump(doctored, fh)
+        monkeypatch.setenv("SAGECAL_KERNEL_VMEM_TABLE", tpath)
+        assert batch_rows_bound() == 200
+        path, reason = choose_batched_path(data, None, p0, cfg)
+        assert path == "fused_batch", reason
+
+
+# --------------------------------------------------- checker, end to end
+
+
+class TestKernelCheck:
+    def test_repo_is_clean(self):
+        result = kc.run_kernel_check()
+        assert result["violations"] == [], result["violations"]
+        s = result["summary"]
+        assert s["full_cluster_tile"] == {"shipped": 128, "derived": 128}
+        assert s["batch_rows_max"] == {
+            "shipped": 104, "f32": 104, "bf16": 111}
+
+    def test_cli_exit_codes(self, capsys):
+        assert kc.main([]) == 0
+        capsys.readouterr()
+
+    def test_crosscheck_against_memory_analysis(self, model):
+        # the model's HBM operand totals must agree with what jax's own
+        # memory_analysis() reports for the compiled executables (CPU
+        # AOT; operand bytes have matched EXACTLY in practice, the rtol
+        # only absorbs runtime-added descriptors)
+        violations = kc._check_crosscheck(model)
+        assert violations == [], violations
+
+
+# ------------------------------------------------- seeded-mutation kit
+
+
+def _mutate(src: str, old: str, new: str) -> str:
+    assert old in src, "mutation anchor vanished: %r" % old[:60]
+    return src.replace(old, new)
+
+
+KERNEL_MUTATIONS = [
+    # drop a real cotangent from the predict backward -> JL013
+    ("drop-cotangent", "JL013",
+     "return dre, dim, None, None, None\n\n\nfused_predict_packed.defvjp",
+     "return dre, None, None, None, None\n\n\nfused_predict_packed.defvjp"),
+    # un-upcast the bf16 coherency load -> JL014
+    ("skip-upcast", "JL014",
+     "c_re = [coh_ref[:, f, k, :].astype(jnp.float32) for k in range(4)]",
+     "c_re = [coh_ref[:, f, k, :] for k in range(4)]"),
+    # un-pin the selection matmul accumulator -> JL014
+    ("unpin-dot", "JL014",
+     "return jnp.dot(t, oh, preferred_element_type=jnp.float32,",
+     "return jnp.dot(t, oh,"),
+    # widen the shipped tile past what the model proves -> tile-bound
+    ("tile-overreach", "tile-bound",
+     "FULL_CLUSTER_TILE = 128",
+     "FULL_CLUSTER_TILE = 256"),
+    # break a BlockSpec index_map rank -> JL015
+    ("rank-mismatch", "JL015",
+     "return pl.BlockSpec((1, tile), lambda r: (0, r), "
+     "memory_space=pltpu.VMEM)",
+     "return pl.BlockSpec((1, tile), lambda r: (0, 0, r), "
+     "memory_space=pltpu.VMEM)"),
+]
+
+
+class TestSeededMutations:
+    @pytest.mark.parametrize(
+        "name,kind,old,new", KERNEL_MUTATIONS,
+        ids=[m[0] for m in KERNEL_MUTATIONS])
+    def test_kernel_mutation_is_caught(self, tmp_path, name, kind,
+                                       old, new):
+        src = open(kc.default_kernel_path()).read()
+        mutated = str(tmp_path / "rime_kernel.py")
+        with open(mutated, "w") as fh:
+            fh.write(_mutate(src, old, new))
+        result = kc.run_kernel_check(kernel_path=mutated,
+                                     check_table=False)
+        assert result["violations"], name
+        assert kind in result["summary"]["kinds"], result["summary"]
+
+    def test_batched_bound_mutation_is_caught(self, tmp_path):
+        src = open(kc.default_batched_path()).read()
+        mutated = str(tmp_path / "batched.py")
+        with open(mutated, "w") as fh:
+            fh.write(_mutate(src, "_BATCH_ROWS_MAX = 104",
+                             "_BATCH_ROWS_MAX = 160"))
+        result = kc.run_kernel_check(batched_path=mutated,
+                                     check_table=False, lint=False)
+        assert result["violations"]
+        assert "batch-rows-bound" in result["summary"]["kinds"]
+
+    def test_unmutated_sandbox_is_clean(self, tmp_path):
+        # the kit's control arm: a byte-identical copy must pass, so a
+        # mutation failure is attributable to the mutation alone
+        src = open(kc.default_kernel_path()).read()
+        copy = str(tmp_path / "rime_kernel.py")
+        with open(copy, "w") as fh:
+            fh.write(src)
+        result = kc.run_kernel_check(kernel_path=copy, check_table=False)
+        assert result["violations"] == [], result["violations"]

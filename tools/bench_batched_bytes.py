@@ -25,7 +25,7 @@ M up to ``pad_to(M, 8)``, so an M=2 comparison would charge the kernel
 for streaming 4x zero-padded coherency rows the XLA path never touches
 — at M=8 both sides stream exactly the real data.  B*Mp = 64 sits
 inside the backward kernel's VMEM accumulator bound
-(solvers/batched._BATCH_ROWS_MAX = 104), i.e. this is a shape
+(solvers/batched.batch_rows_bound(), table-driven), i.e. this is a shape
 ``choose_batched_path`` actually routes to ``fused_batch``.
 
 Everything is lowered from ``jax.ShapeDtypeStruct`` abstract arguments
@@ -190,12 +190,13 @@ def main(argv=None) -> int:
     jax.config.update("jax_platforms", "cpu")  # AOT analysis only
 
     from sagecal_tpu.ops.rime_kernel import pad_to
-    from sagecal_tpu.solvers.batched import _BATCH_ROWS_MAX
+    from sagecal_tpu.solvers.batched import batch_rows_bound
 
+    rows_max = batch_rows_bound()
     batch_rows = args.batch * pad_to(args.nclusters, 8)
-    if batch_rows > _BATCH_ROWS_MAX:
+    if batch_rows > rows_max:
         print(f"B*Mp={batch_rows} exceeds the backward kernel's VMEM "
-              f"bound ({_BATCH_ROWS_MAX}); choose_batched_path would "
+              f"bound ({rows_max}); choose_batched_path would "
               f"never route this shape to fused_batch", file=sys.stderr)
         return 2
 

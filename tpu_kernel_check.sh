@@ -45,6 +45,20 @@ if ! JAX_PLATFORMS=cpu timeout 120 python tools/jaxlint.py --rules JL011 \
   echo "JL011 SPOT-CHECK FAILED (use-after-donation on batched path) - stop"
   exit 1
 fi
+# kernel contract gate, still CPU-only: the symbolic VMEM model must
+# prove FULL_CLUSTER_TILE/_BATCH_ROWS_MAX feasible, every grid must
+# cover its padded extents, the JL013-JL015 kernel lints must be clean,
+# and the banked KERNEL_VMEM_TABLE.json (what choose_batched_path
+# reads) must match the model — all before any TPU time is spent
+echo "=== kernel contract gate (VMEM model + JL013-JL015 + table)"
+if ! JAX_PLATFORMS=cpu timeout 180 python -m sagecal_tpu.obs.diag \
+    kernelcheck; then
+  echo "KERNEL CONTRACT GATE FAILED - stop"; exit 1
+fi
+if ! JAX_PLATFORMS=cpu timeout 120 python tools/kernel_vmem_table.py \
+    --check; then
+  echo "KERNEL_VMEM_TABLE.json STALE (regenerate + commit) - stop"; exit 1
+fi
 # fused-OBJECTIVE parity smoke next, still CPU-only: the interpret-mode
 # kernel must match the XLA replica (cost + grad <=1e-5 rel, masked and
 # padded edges) before any TPU time is spent on it; batched_fused covers
@@ -157,7 +171,7 @@ if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
 echo "=== telemetry+quality+trace+serve_obs+fleet+stream+sky+protocol+devprof+load+drift test pass (CPU, marker-driven)"
 JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 1200 \
   python -m pytest tests/ -q \
-  -m "telemetry or quality or trace or serve_obs or fleet or stream or sky or protocol or devprof or load or drift" \
+  -m "telemetry or quality or trace or serve_obs or fleet or stream or sky or protocol or devprof or load or drift or kernelcheck" \
   -p no:cacheprovider | tail -3
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then echo "telemetry test pass FAILED rc=$rc"; exit 1; fi
