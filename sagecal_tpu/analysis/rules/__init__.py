@@ -25,6 +25,7 @@ from sagecal_tpu.analysis.rules.jl012 import MixedDtypeComparison
 from sagecal_tpu.analysis.rules.jl013 import CotangentCompleteness
 from sagecal_tpu.analysis.rules.jl014 import PrecisionFlow
 from sagecal_tpu.analysis.rules.jl015 import BlockSpecHazard
+from sagecal_tpu.analysis.rules.jl016 import BufferedJsonlAppend
 from sagecal_tpu.analysis.rules.jl900 import DeadImport
 
 
@@ -45,5 +46,6 @@ def all_rules() -> List[Type[Rule]]:
         CotangentCompleteness,
         PrecisionFlow,
         BlockSpecHazard,
+        BufferedJsonlAppend,
         DeadImport,
     ]

@@ -19,6 +19,7 @@ Exit codes: 0 queue fully drained; 4 requests left undrained.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from sagecal_tpu.apps.config import FleetConfig
@@ -188,7 +189,14 @@ def _obs_setup(cfg, role: str):
     manifest = RunManifest.collect(
         kernel_path="xla", app="fleet", role=role,
         out_dir=cfg.out_dir)
-    elog = default_event_log(manifest=manifest)
+    # fleet/load runs default the event log INTO the out-dir (rather
+    # than the CWD) so every record family of one run lands in one
+    # auditable directory; SAGECAL_EVENT_LOG still overrides, and the
+    # spawned workers inherit the same resolution via --out-dir
+    path = None
+    if not os.environ.get("SAGECAL_EVENT_LOG") and cfg.out_dir:
+        path = os.path.join(cfg.out_dir, "sagecal_events.jsonl")
+    elog = default_event_log(manifest=manifest, path=path)
     install_crash_handlers()
     if elog is not None:
         register_event_log(elog)

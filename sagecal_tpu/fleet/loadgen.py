@@ -46,7 +46,9 @@ from typing import Any, Dict, List, Tuple
 
 ARRIVAL_KINDS = ("poisson", "onoff", "ramp")
 
-LOAD_STEPS_SCHEMA_VERSION = 1
+# v2: the doc carries a writer-identity stamp (obs/ledger.py accepts
+# both versions)
+LOAD_STEPS_SCHEMA_VERSION = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -432,6 +434,10 @@ class LoadRunner:
                            t1=t_start + s["t1"]) for s in steps],
             "submitted": submitted,
         }
+        from sagecal_tpu.obs.events import writer_identity
+
+        doc["writer"] = writer_identity()
+        doc["pid"] = os.getpid()
         path = os.path.join(self.cfg.out_dir, "load_steps.json")
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:

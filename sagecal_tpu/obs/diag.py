@@ -90,6 +90,26 @@ Subcommands:
   (``shadow.DRIFT_TOLERANCES``).  Exit 1 on any tolerance breach or
   structural ledger problem; exit 0 with a warning when no samples.
 
+- ``audit OUT_DIR [--events LOG] [--queue DIR] [--max-skew S]
+  [--slack S] [--json] [-V]`` — the event-sourced fleet audit
+  (:mod:`sagecal_tpu.obs.audit`): validate every record file through
+  the schema registry (ok/torn/foreign/out-of-schema), replay the
+  fleet purely from the records, and assert the conservation laws
+  (enqueued == served + shed + failed + pending, one manifest per
+  request, lease-epoch monotonicity with steals only after TTL
+  expiry, span-chain completeness, counter monotonicity, timeline
+  depth bounds, clock-skew feasibility, sequence holes, unregistered
+  files).  Exit 1 on any violation or observability gap, exit 2
+  (INSUFFICIENT) when there are no queue items to conserve.
+  ``SAGECAL_AUDIT_INJECT=drop_event|tear_record|forge_manifest|
+  skew_clock`` injects an in-memory fault to prove the detector.
+
+- ``replay OUT_DIR [--events LOG] [--queue DIR] [--json] [-V]`` — the
+  reconstruction alone (:mod:`sagecal_tpu.obs.replay`): queue state,
+  per-request dispositions, per-worker lifecycle, per-writer clock
+  offsets estimated from happens-before edges, and replayed SLO
+  attainment.  Exit 2 when there is nothing to replay.
+
 Runs standalone (``python -m sagecal_tpu.obs.diag ...``) or via the
 ``diag`` subcommand of the main CLI (:mod:`sagecal_tpu.apps.cli`).
 """
@@ -932,6 +952,50 @@ def _cmd_protocol(args) -> int:
     return 0
 
 
+def _cmd_audit(args) -> int:
+    """Event-sourced fleet audit: schema-registry validation, replay,
+    conservation-law checks.  Exit 0 clean / 1 violation or gap / 2
+    insufficient records (nothing to conserve)."""
+    from sagecal_tpu.obs.audit import format_audit, run_audit
+
+    report = run_audit(
+        args.out_dir, events_path=args.events, queue_dir=args.queue,
+        max_skew_s=args.max_skew, slack_s=args.slack,
+        inject=args.inject)
+    if args.json:
+        print(json.dumps(report.to_doc(), indent=2, sort_keys=True))
+    else:
+        print(format_audit(report, verbose=args.verbose))
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report.to_doc(), f, indent=2, sort_keys=True)
+        print(f"audit report -> {args.report}")
+    return report.exit_code()
+
+
+def _cmd_replay(args) -> int:
+    """Deterministic fleet replay from records alone (no live state).
+    Exit 2 when there is nothing to replay."""
+    from sagecal_tpu.obs.replay import format_replay, load_run, replay
+
+    rec = load_run(args.out_dir, events_path=args.events,
+                   queue_dir=args.queue)
+    if not rec.items and not rec.manifests and not rec.events:
+        print(f"{args.out_dir}: no replayable records "
+              "(no queue items, manifests, or events)", file=sys.stderr)
+        return 2
+    state = replay(rec)
+    if args.json:
+        print(json.dumps(state.to_doc(), indent=2, sort_keys=True))
+    else:
+        print(format_replay(state, verbose=args.verbose))
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(state.to_doc(), f, indent=2, sort_keys=True)
+        print(f"replay state -> {args.report}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="sagecal-tpu diag",
@@ -1083,6 +1147,61 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--report", default=None,
                     help="also write the machine-readable JSON report")
     dp.set_defaults(fn=_cmd_drift)
+
+    aup = sub.add_parser(
+        "audit",
+        help="event-sourced fleet audit: schema-registry validation, "
+             "deterministic replay, conservation-law gating (exit 1 "
+             "on violation/gap, 2 on insufficient records)",
+    )
+    aup.add_argument("out_dir",
+                     help="a fleet/load/serve --out-dir (queue/ + "
+                          "manifests + sagecal_events.jsonl + "
+                          "timeline.jsonl)")
+    aup.add_argument("--events", default=None,
+                     help="event log override (default "
+                          "<out_dir>/sagecal_events.jsonl)")
+    aup.add_argument("--queue", default=None,
+                     help="queue dir override (default <out_dir>/queue)")
+    aup.add_argument("--max-skew", type=float, default=30.0,
+                     help="max tolerated per-writer clock offset, "
+                          "seconds (default 30)")
+    aup.add_argument("--slack", type=float, default=3.0,
+                     help="timing slack for lease/timeline checks, "
+                          "seconds (default 3)")
+    aup.add_argument("--inject", default=None,
+                     choices=("drop_event", "tear_record",
+                              "forge_manifest", "skew_clock"),
+                     help="inject an in-memory fault to prove the "
+                          "detector (also: SAGECAL_AUDIT_INJECT)")
+    aup.add_argument("--json", action="store_true",
+                     help="print the full report as JSON")
+    aup.add_argument("--report", default=None,
+                     help="also write the machine-readable JSON report")
+    aup.add_argument("-V", "--verbose", action="store_true",
+                     help="list every violation and per-writer detail")
+    aup.set_defaults(fn=_cmd_audit)
+
+    rpp = sub.add_parser(
+        "replay",
+        help="deterministic fleet replay from records alone: queue "
+             "state, request dispositions, worker lifecycle, clock "
+             "offsets, SLO attainment (exit 2 when nothing to replay)",
+    )
+    rpp.add_argument("out_dir",
+                     help="a fleet/load/serve --out-dir")
+    rpp.add_argument("--events", default=None,
+                     help="event log override (default "
+                          "<out_dir>/sagecal_events.jsonl)")
+    rpp.add_argument("--queue", default=None,
+                     help="queue dir override (default <out_dir>/queue)")
+    rpp.add_argument("--json", action="store_true",
+                     help="print the replayed state as JSON")
+    rpp.add_argument("--report", default=None,
+                     help="also write the replayed state JSON here")
+    rpp.add_argument("-V", "--verbose", action="store_true",
+                     help="per-request and per-writer detail")
+    rpp.set_defaults(fn=_cmd_replay)
 
     qp = sub.add_parser(
         "quality",

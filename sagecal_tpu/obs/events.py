@@ -35,6 +35,20 @@ _REQUIRED_MANIFEST_KEYS = (
 )
 
 
+def writer_identity() -> str:
+    """This process's stable writer identity, stamped on every emitted
+    record so the offline auditor (obs/ledger.py) can attribute lines
+    in a shared O_APPEND file to their writer and detect per-writer
+    sequence holes.  ``<worker>@<pid>``: the fleet worker name when
+    ``SAGECAL_WORKER_ID`` is set (coordinator-spawned workers), else a
+    pid-derived stand-in.  The part before ``@`` is the writer's clock
+    domain (one wall clock per process; a respawned worker is a new
+    domain instance but shares the worker-name prefix)."""
+    wid = os.environ.get("SAGECAL_WORKER_ID", "").strip()
+    pid = os.getpid()
+    return f"{wid or 'p%d' % pid}@{pid}"
+
+
 def _jsonable(x):
     """Best-effort conversion of numpy/jax scalars and arrays to plain
     JSON types (events must never fail to serialize)."""
@@ -160,6 +174,8 @@ class EventLog:
         self.run_id = run_id or (
             manifest.run_id if manifest is not None else uuid.uuid4().hex[:12]
         )
+        self.writer = writer_identity()
+        self._seq = 0
         if manifest is not None:
             self.emit("run_manifest", **manifest.to_dict())
 
@@ -175,6 +191,18 @@ class EventLog:
         for k, v in fields.items():
             if k not in rec:
                 rec[k] = _jsonable(v)
+        # audit stamps go LAST so the byte layout existing consumers
+        # key on (ts/run_id/type prefix, then caller fields) is
+        # unchanged: writer identity + a per-writer sequence number
+        # (hole detection) + a monotonic reading (ordering within a
+        # writer survives wall-clock steps)
+        if "writer" not in rec:
+            rec["writer"] = self.writer
+        if "mono" not in rec:
+            rec["mono"] = time.monotonic()
+        if "seq" not in rec:
+            rec["seq"] = self._seq
+            self._seq += 1
         os.write(fd, (json.dumps(rec) + "\n").encode("utf-8"))
 
     def close(self) -> None:

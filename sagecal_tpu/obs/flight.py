@@ -43,7 +43,8 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional
 
-DUMP_SCHEMA_VERSION = 1
+# v2: dumps carry a writer-identity stamp (obs/ledger.py accepts both)
+DUMP_SCHEMA_VERSION = 2
 
 _TRUTHY = ("1", "true", "yes", "on")
 
@@ -326,6 +327,10 @@ class FlightRecorder:
             "last_checkpoint": _LAST_CHECKPOINT,
             "device_profile_trace": _device_profile_trace(),
         }
+        from sagecal_tpu.obs.events import writer_identity
+
+        doc["writer"] = writer_identity()
+        doc["mono"] = time.monotonic()
         if exc_info is not None:
             tp, val, tb = exc_info
             doc["exception"] = {

@@ -272,6 +272,10 @@ class ShadowAuditor:
         self.audited = 0
         self.budget_skipped = 0
         self.exceeded: List[str] = []  # request ids over tolerance
+        from sagecal_tpu.obs.events import writer_identity
+
+        self._writer = writer_identity()
+        self._seq = 0
 
     # -- membership / budget -------------------------------------------
 
@@ -358,6 +362,11 @@ class ShadowAuditor:
             "res_1_ref": res1_ref,
         }
         record.update(metrics)
+        # audit stamps, appended after the v1 layout (obs/ledger.py)
+        record["writer"] = self._writer
+        record["mono"] = time.monotonic()
+        record["seq"] = self._seq
+        self._seq += 1
         fd = self._fd
         if fd is not None:
             os.write(fd, (json.dumps(record) + "\n").encode("utf-8"))

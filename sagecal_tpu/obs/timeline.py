@@ -36,7 +36,10 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Set
 
-TIMELINE_SCHEMA_VERSION = 1
+# v2: rows carry writer identity + mono/seq audit stamps (appended
+# after the v1 keys; v1 rows remain valid)
+TIMELINE_SCHEMA_VERSION = 2
+_TIMELINE_KNOWN_VERSIONS = (1, 2)
 TIMELINE_KIND = "fleet_timeline"
 
 #: default timeline filename inside a fleet/load out-dir
@@ -77,6 +80,10 @@ class TimelineSampler:
             path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
         self._seen: Set[str] = set()
         self._verdicts: Dict[str, int] = {}
+        from sagecal_tpu.obs.events import writer_identity
+
+        self._writer = writer_identity()
+        self._row_seq = 0
         self._monitor = None
         if slo_specs:
             from sagecal_tpu.obs.slo import SLOMonitor
@@ -171,6 +178,12 @@ class TimelineSampler:
         for k, v in extra.items():
             if k not in row:
                 row[k] = v
+        # v2 audit stamps, appended after the v1 layout
+        row.setdefault("writer", self._writer)
+        row.setdefault("mono", time.monotonic())
+        if "seq" not in row:
+            row["seq"] = self._row_seq
+            self._row_seq += 1
         fd = self._fd
         if fd is not None:
             os.write(fd, (json.dumps(row) + "\n").encode("utf-8"))
@@ -222,10 +235,10 @@ def validate_timeline(rows) -> List[str]:
             if k not in row:
                 problems.append(f"row {i}: missing key {k}")
         sv = row.get("schema_version")
-        if sv is not None and sv != TIMELINE_SCHEMA_VERSION:
+        if sv is not None and sv not in _TIMELINE_KNOWN_VERSIONS:
             problems.append(
-                f"row {i}: schema_version {sv} != "
-                f"{TIMELINE_SCHEMA_VERSION}")
+                f"row {i}: schema_version {sv} not in "
+                f"{_TIMELINE_KNOWN_VERSIONS}")
         ts = row.get("ts")
         if isinstance(ts, (int, float)):
             if last_ts is not None and ts < last_ts:

@@ -44,7 +44,10 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-SPAN_SCHEMA_VERSION = 1
+# v2: spans carry writer identity + mono/seq audit stamps (appended
+# after the v1 keys; v1 readers are unaffected, the offline auditor in
+# obs/ledger.py accepts both versions)
+SPAN_SCHEMA_VERSION = 2
 
 _TRUTHY = ("1", "true", "yes", "on")
 
@@ -163,6 +166,10 @@ class Tracer:
         self._ids = itertools.count(1)
         self._pid = os.getpid()
         self._lock = threading.Lock()
+        from sagecal_tpu.obs.events import writer_identity
+
+        self._writer = writer_identity()
+        self._seq = itertools.count(0)
 
     def _stack(self) -> List[str]:
         stack = getattr(self._local, "stack", None)
@@ -231,6 +238,12 @@ class Tracer:
         }
         if attrs:
             rec["attrs"] = {str(k): _jsonable(v) for k, v in attrs.items()}
+        # v2 audit stamps, appended after the v1 layout: writer
+        # identity + per-writer sequence + a monotonic reading taken at
+        # write time (same-writer ordering under wall-clock steps)
+        rec["writer"] = self._writer
+        rec["mono"] = time.monotonic()
+        rec["seq"] = next(self._seq)
         line = (json.dumps(rec) + "\n").encode("utf-8")
         fd = self._fd
         if fd is None:

@@ -1,0 +1,22 @@
+"""Must-not-fire fixture for JL016: the registered O_APPEND
+single-write emitter, the tmp + os.replace staging idiom, and a
+newline-free whole-document write are all exempt."""
+import json
+import os
+
+
+def emit_line(fd, rec):
+    line = (json.dumps(rec, sort_keys=True) + "\n").encode()
+    os.write(fd, line)
+
+
+def stage_and_publish(path, rows):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    os.replace(tmp, path)
+
+
+def write_doc(fh, doc):
+    fh.write(json.dumps(doc))

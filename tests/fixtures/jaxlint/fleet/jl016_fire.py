@@ -1,0 +1,12 @@
+"""Should-fire fixture for JL016 (lives under fleet/ for path scope):
+two JSONL appends through buffered file handles."""
+import json
+
+
+def append_event(fh, rec):
+    fh.write(json.dumps(rec) + "\n")
+
+
+def append_span(log, span):
+    with open(log, "a") as f:
+        f.write(json.dumps(span, sort_keys=True) + "\n")

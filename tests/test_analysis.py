@@ -69,6 +69,7 @@ FIRE_CASES = [
     ("JL013", "jl013_fire.py", 3),
     ("JL014", "jl014_fire.py", 3),
     ("JL015", "jl015_fire.py", 3),
+    ("JL016", os.path.join("fleet", "jl016_fire.py"), 2),
     ("JL900", "jl900_fixture.py", 2),
 ]
 
@@ -87,6 +88,7 @@ CLEAN_CASES = [
     ("JL013", "jl013_clean.py"),
     ("JL014", "jl014_clean.py"),
     ("JL015", "jl015_clean.py"),
+    ("JL016", os.path.join("fleet", "jl016_clean.py")),
 ]
 
 
@@ -254,7 +256,7 @@ class TestCLI:
         for rid in ("JL001", "JL002", "JL003", "JL004", "JL005",
                     "JL006", "JL007", "JL008", "JL009", "JL010",
                     "JL011", "JL012", "JL013", "JL014", "JL015",
-                    "JL900"):
+                    "JL016", "JL900"):
             assert rid in out
         assert "report-only" in out
 

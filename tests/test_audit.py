@@ -1,0 +1,607 @@
+"""Event-sourced fleet audit (obs/ledger.py, obs/replay.py,
+obs/audit.py, tools/backfill_record_schemas.py).
+
+The flagship checks:
+
+- **every** registered record family survives the validating reader's
+  torn / crash-mid-write / foreign / out-of-schema gauntlet through
+  one parametrized harness, so adding a family without classification
+  coverage fails here;
+- replaying a real :class:`LeaseQueue` run from its record files alone
+  reproduces the live ``stats()`` view (the replay engine is a pure
+  function of the records);
+- a known injected clock offset is recovered from happens-before edges
+  (the oracle), and each of the four ``SAGECAL_AUDIT_INJECT`` arms is
+  caught with its pinned violation kind while the clean control passes;
+- the writer/mono/seq audit stamps are appended AFTER the v1 byte
+  layout, pinned so pre-audit consumers keep parsing unchanged
+  prefixes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from sagecal_tpu.fleet.queue import LeaseQueue, WorkItem
+from sagecal_tpu.obs import ledger
+from sagecal_tpu.obs.audit import (
+    EXIT_INSUFFICIENT,
+    EXIT_OK,
+    EXIT_VIOLATION,
+    INJECTION_KINDS,
+    KIND_CLOCK_SKEW,
+    KIND_GAP,
+    apply_injection,
+    run_audit,
+)
+from sagecal_tpu.obs.events import EventLog, read_events, writer_identity
+from sagecal_tpu.obs.replay import domain_of, load_run, replay
+from sagecal_tpu.obs.timeline import TimelineSampler, read_timeline, validate_timeline
+from sagecal_tpu.obs.trace import Tracer, read_spans
+
+pytestmark = pytest.mark.audit
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+# One canonical (relative path, valid record) per registered family.
+# test_every_family_has_a_factory pins this dict to ledger.REGISTRY, so
+# registering a new family without gauntlet coverage fails the suite.
+FAMILY_SAMPLES = {
+    "event": ("sagecal_events.jsonl",
+              {"ts": 100.0, "run_id": "r", "type": "fleet_seeded",
+               "writer": "co@500", "mono": 1.0, "seq": 0}),
+    "span": ("sagecal_trace.jsonl",
+             {"kind": "span", "schema_version": 2, "trace_id": "t1",
+              "span_id": "1f4.1", "parent_id": None, "name": "solve",
+              "ts": 100.0, "dur": 0.5, "pid": 500,
+              "writer": "co@500", "mono": 1.0, "seq": 0}),
+    "timeline": ("timeline.jsonl",
+                 {"schema_version": 2, "kind": "fleet_timeline",
+                  "ts": 100.0, "items": 1, "done": 0, "waiting": 1,
+                  "leased": 0, "expired_leases": 0, "alive_workers": 1,
+                  "writer": "co@500", "mono": 1.0, "seq": 0}),
+    "drift": ("drift.jsonl",
+              {"schema_version": 1, "kind": "shadow_drift", "ts": 100.0,
+               "request_id": "req000", "path_pair": "fused_vs_xla",
+               "kernel_path": "fused", "verdict": "ok",
+               "shadow_s": 0.2}),
+    "bench_history": ("BENCH_HISTORY.jsonl",
+                      {"history_schema_version": 2, "ts": 100.0,
+                       "metric": "wall_s", "value": 1.5}),
+    "queue_item": ("queue/item-req000.json",
+                   {"request_id": "req000", "tenant": "t0",
+                    "request": {}, "deadline": None, "bucket_hint": "",
+                    "enqueued_at": 100.0, "large": False}),
+    "queue_lease": ("queue/lease-req000.e000001.json",
+                    {"worker": "w0", "request_id": "req000",
+                     "acquired_at": 101.0, "renewed_at": 101.0,
+                     "expires_at": 111.0}),
+    "queue_done": ("queue/done-req000.json",
+                   {"request_id": "req000", "worker": "w0",
+                    "completed_at": 105.0, "verdict": "ok"}),
+    "queue_fail": ("queue/fail-req000.abc123.json",
+                   {"request_id": "req000", "worker": "w0",
+                    "ts": 103.0, "error": "boom"}),
+    "result_manifest": ("req000.result.json",
+                        {"request_id": "req000", "tenant": "t0",
+                         "verdict": "ok", "enqueued_at": 100.0,
+                         "started_at": 101.0, "completed_at": 105.0,
+                         "latency_s": 5.0, "trace_id": ""}),
+    "metrics_snapshot": ("metrics-w0.json",
+                         {"kind": "metrics_snapshot",
+                          "schema_version": 1, "ts": 105.0, "pid": 501,
+                          "worker_id": "w0", "state": "idle"}),
+    "load_steps": ("load_steps.json",
+                   {"schema_version": 2, "kind": "load_steps",
+                    "seed": 7, "arrival": "poisson", "t_start": 100.0,
+                    "steps": [], "submitted": 0, "writer": "lg@500",
+                    "pid": 500}),
+    "flight_dump": ("flight_dump.json",
+                    {"schema_version": 2, "reason": "stall",
+                     "ts": 100.0, "pid": 500, "run_id": "r",
+                     "writer": "co@500"}),
+    "heartbeat": (".sagecal_heartbeat", {"pid": 500, "ts": 100.0}),
+}
+
+
+def _nz(counts):
+    """Drop zero entries: counts() reports every status."""
+    return {k: v for k, v in counts.items() if v}
+
+
+def _droppable(fam):
+    """A required key whose absence means out-of-schema, not foreign
+    (dropping the kind discriminator would reclassify the record)."""
+    return next(k for k in fam.required
+                if k not in (fam.kind_field, fam.version_field))
+
+
+def _write_record(root, name):
+    rel, rec = FAMILY_SAMPLES[name]
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path) or root, exist_ok=True)
+    fam = ledger.family(name)
+    with open(path, "w") as f:
+        if fam.container == "jsonl":
+            f.write(json.dumps(rec) + "\n")
+        else:
+            f.write(json.dumps(rec))
+    return path, rec
+
+
+class TestLedger:
+    def test_every_family_has_a_factory(self):
+        assert set(FAMILY_SAMPLES) == {f.name for f in ledger.REGISTRY}
+
+    @pytest.mark.parametrize("name", sorted(FAMILY_SAMPLES))
+    def test_match_and_valid_record_ok(self, name, tmp_path):
+        rel, _rec = FAMILY_SAMPLES[name]
+        fam = ledger.match_family(rel)
+        assert fam is not None and fam.name == name, rel
+        path, _ = _write_record(str(tmp_path), name)
+        vf = ledger.read_validated(path, fam)
+        assert _nz(vf.counts()) == {"ok": 1}, vf.records
+
+    @pytest.mark.parametrize("name", sorted(
+        n for n in FAMILY_SAMPLES
+        if ledger.family(n).container == "jsonl"))
+    def test_jsonl_gauntlet(self, name, tmp_path):
+        """One file holding a valid line, a crash-torn line, a foreign
+        line, and an out-of-schema line: each classified, none skipped
+        silently."""
+        rel, rec = FAMILY_SAMPLES[name]
+        fam = ledger.family(name)
+        bad = dict(rec)
+        bad.pop(_droppable(fam))
+        path = tmp_path / os.path.basename(rel)
+        path.write_text(
+            json.dumps(rec) + "\n"
+            + json.dumps(["foreign", "payload"]) + "\n"
+            + json.dumps(bad) + "\n"
+            + json.dumps(rec)[: len(json.dumps(rec)) // 2] + "\n")
+        vf = ledger.read_validated(str(path), fam)
+        assert _nz(vf.counts()) == {"ok": 1, "foreign": 1,
+                               "out_of_schema": 1, "torn": 1}, \
+            [(c.status, c.reason) for c in vf.records]
+        # the torn tail is exactly what a crash mid-write leaves
+        assert vf.by_status(ledger.TORN)[0].line_no == 4
+
+    @pytest.mark.parametrize("name", sorted(
+        n for n in FAMILY_SAMPLES
+        if ledger.family(n).container == "json"))
+    def test_json_doc_gauntlet(self, name, tmp_path):
+        rel, rec = FAMILY_SAMPLES[name]
+        fam = ledger.family(name)
+        path = tmp_path / os.path.basename(rel)
+        # crash mid-write: truncated document -> torn
+        path.write_text(json.dumps(rec)[: len(json.dumps(rec)) // 2])
+        assert _nz(ledger.read_validated(str(path), fam).counts()) \
+            == {"torn": 1}
+        # foreign: parses, but is not this family's record shape
+        path.write_text(json.dumps(["not", "a", "record"]))
+        assert _nz(ledger.read_validated(str(path), fam).counts()) \
+            == {"foreign": 1}
+        # out-of-schema: right shape, missing a required field
+        bad = dict(rec)
+        dropped = _droppable(fam)
+        bad.pop(dropped)
+        path.write_text(json.dumps(bad))
+        vf = ledger.read_validated(str(path), fam)
+        assert _nz(vf.counts()) == {"out_of_schema": 1}
+        assert dropped in vf.records[0].reason
+
+    def test_unknown_schema_version_is_out_of_schema(self, tmp_path):
+        _rel, rec = FAMILY_SAMPLES["span"]
+        fut = dict(rec, schema_version=99)
+        path = tmp_path / "sagecal_trace.jsonl"
+        path.write_text(json.dumps(fut) + "\n")
+        vf = ledger.read_validated(str(path), ledger.family("span"))
+        assert _nz(vf.counts()) == {"out_of_schema": 1}
+        assert "99" in vf.records[0].reason
+
+    def test_scan_classifies_and_flags_unregistered(self, tmp_path):
+        for name in FAMILY_SAMPLES:
+            _write_record(str(tmp_path), name)
+        (tmp_path / "mystery_records.json").write_text(
+            json.dumps({"x": 1}))
+        (tmp_path / "load_report.json").write_text("{}")  # ignored
+        scan = ledger.scan_out_dir(str(tmp_path))
+        assert scan.counts().get("ok") == len(FAMILY_SAMPLES)
+        assert [os.path.basename(p) for p in scan.unregistered] == \
+            ["mystery_records.json"]
+        assert any("load_report" in p for p in scan.ignored)
+
+    def test_sequence_holes_vs_stopped_writer(self):
+        recs = [{"writer": "w0@1", "seq": s} for s in (0, 1, 3, 4)]
+        holes = ledger.sequence_holes(recs)
+        assert holes == {"w0@1": [2]}
+        # a SIGKILLed writer's stream just STOPS — no hole invented
+        recs = [{"writer": "w0@1", "seq": s} for s in (0, 1, 2)]
+        assert ledger.sequence_holes(recs) == {}
+        # two pids of a respawned worker are separate seq streams
+        recs = [{"writer": "w0@1", "seq": 0}, {"writer": "w0@2", "seq": 0}]
+        assert ledger.sequence_holes(recs) == {}
+
+
+class TestStampLayout:
+    """The v2 audit stamps ride AFTER the v1 byte layout, so pre-audit
+    consumers parsing key-ordered prefixes see nothing move."""
+
+    def _keys(self, line):
+        pairs = json.loads(line, object_pairs_hook=lambda p: p)
+        return [k for k, _v in pairs]
+
+    def test_event_line_layout(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        with EventLog(p, run_id="r1") as log:
+            log.emit("tile_done", tile=3, res=1.5)
+        line = [l for l in open(p) if "tile_done" in l][0]
+        keys = self._keys(line)
+        assert keys[:3] == ["ts", "run_id", "type"]
+        assert keys[-3:] == ["writer", "mono", "seq"]
+        # the v1 reader still reads v2 files
+        evs = read_events(p)
+        assert [e["type"] for e in evs][-1] == "tile_done"
+        assert evs[-1]["writer"] == writer_identity()
+
+    def test_event_seq_is_per_writer_contiguous(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        with EventLog(p, run_id="r1") as log:
+            for i in range(4):
+                log.emit("tick", i=i)
+        seqs = [e["seq"] for e in read_events(p)]
+        assert seqs == list(range(len(seqs)))
+        assert ledger.sequence_holes(read_events(p)) == {}
+
+    def test_span_line_layout(self, tmp_path):
+        p = str(tmp_path / "tr.jsonl")
+        tr = Tracer(p, trace_id="t1")
+        with tr.span("solve", tile=1):
+            pass
+        tr.close()
+        line = [l for l in open(p) if '"span"' in l][0]
+        keys = self._keys(line)
+        assert keys[0] == "kind"
+        assert keys[-3:] == ["writer", "mono", "seq"]
+        spans = read_spans(p)
+        assert spans and spans[0]["name"] == "solve"
+        assert spans[0]["schema_version"] == 2
+
+    def test_timeline_row_layout(self, tmp_path):
+        q = LeaseQueue(str(tmp_path / "queue"), worker="w0", ttl_s=10.0)
+        q.put(WorkItem(request_id="r0", tenant="t0", request={}),
+              now=100.0)
+        p = str(tmp_path / "timeline.jsonl")
+        with TimelineSampler(p, queue=q, clock=lambda: 100.5) as s:
+            s.sample(now=100.5, alive_workers=1)
+        line = open(p).read().splitlines()[0]
+        keys = self._keys(line)
+        assert keys[-3:] == ["writer", "mono", "seq"]
+        rows = read_timeline(p)
+        assert validate_timeline(rows) == []
+        assert rows[0]["items"] == 1 and rows[0]["schema_version"] == 2
+
+
+# --------------------------------------------------- synthesized runs
+
+
+def synth_run(out, skew_s=0.0, deadline=None):
+    """A fully consistent finished fleet run, written as records only:
+    3 requests enqueued by the coordinator (domain ``co``), claimed and
+    served by worker ``w0``, with coherent events, done markers,
+    manifests and timeline.  ``skew_s`` shifts every worker-side wall
+    stamp, modelling a worker whose clock runs ahead by that much."""
+    qd = os.path.join(out, "queue")
+    os.makedirs(qd, exist_ok=True)
+
+    def dump(path, doc):
+        with open(os.path.join(out, path), "w") as f:
+            json.dump(doc, f)
+
+    enq = {"req000": 100.0, "req001": 101.0, "req002": 102.0}
+    done_at = {"req000": 110.0, "req001": 115.0, "req002": 120.0}
+    for rid, t in enq.items():
+        dump(f"queue/item-{rid}.json",
+             {"request_id": rid, "tenant": "t0", "request": {},
+              "deadline": deadline, "bucket_hint": "",
+              "enqueued_at": t, "large": False})
+    for rid, t in done_at.items():
+        dump(f"queue/done-{rid}.json",
+             {"request_id": rid, "worker": "w0",
+              "completed_at": t + skew_s, "verdict": "ok"})
+        dump(f"{rid}.result.json",
+             {"request_id": rid, "tenant": "t0", "verdict": "ok",
+              "enqueued_at": enq[rid], "started_at": enq[rid] + 3.0,
+              "completed_at": t + skew_s,
+              "latency_s": t - enq[rid], "trace_id": ""})
+
+    events = [
+        {"ts": 99.0, "run_id": "r", "type": "run_manifest",
+         "extra": {"role": "coordinator"}, "writer": "co@500", "seq": 0},
+        {"ts": 100.0, "run_id": "r", "type": "fleet_seeded", "n": 3,
+         "writer": "co@500", "seq": 1},
+        {"ts": 103.0 + skew_s, "run_id": "r", "type": "fleet_claimed",
+         "worker": "w0", "n": 3, "writer": "w0@501", "seq": 0},
+        {"ts": 110.0 + skew_s, "run_id": "r", "type": "request_done",
+         "request_id": "req000", "writer": "w0@501", "seq": 1},
+        {"ts": 115.0 + skew_s, "run_id": "r", "type": "request_done",
+         "request_id": "req001", "writer": "w0@501", "seq": 2},
+        {"ts": 120.0 + skew_s, "run_id": "r", "type": "request_done",
+         "request_id": "req002", "writer": "w0@501", "seq": 3},
+        {"ts": 125.0 + skew_s, "run_id": "r",
+         "type": "fleet_worker_done", "worker": "w0", "cycles": 1,
+         "solved": 3, "wall_s": 22.0, "writer": "w0@501", "seq": 4},
+        {"ts": 130.0, "run_id": "r", "type": "fleet_done",
+         "writer": "co@500", "seq": 2},
+    ]
+    with open(os.path.join(out, "sagecal_events.jsonl"), "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+    rows = [
+        {"schema_version": 2, "kind": "fleet_timeline", "ts": 104.0,
+         "items": 3, "done": 0, "waiting": 0, "leased": 3,
+         "expired_leases": 0, "alive_workers": 1,
+         "writer": "co@500", "seq": 0},
+        {"schema_version": 2, "kind": "fleet_timeline", "ts": 121.0,
+         "items": 3, "done": 3, "waiting": 0, "leased": 0,
+         "expired_leases": 0, "alive_workers": 1,
+         "writer": "co@500", "seq": 1},
+    ]
+    with open(os.path.join(out, "timeline.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return out
+
+
+class TestReplay:
+    def test_replay_matches_live_queue_state(self, tmp_path):
+        """Drive a REAL LeaseQueue through claim/renew/expire/fail/
+        complete with an explicit clock, then reconstruct it from the
+        record files alone: the replayed queue counts must equal the
+        live stats() view at the same instant."""
+        out = str(tmp_path)
+        q = LeaseQueue(os.path.join(out, "queue"), worker="w0",
+                       ttl_s=10.0, clock=lambda: 120.0)
+        for i in range(5):
+            q.put(WorkItem(request_id=f"req{i:03d}", tenant="t0",
+                           request={}), now=100.0 + i)
+        # req000: served (claim -> manifest -> complete)
+        assert q.claim("req000", now=105.0)
+        with open(os.path.join(out, "req000.result.json"), "w") as f:
+            json.dump({"request_id": "req000", "tenant": "t0",
+                       "verdict": "ok", "enqueued_at": 100.0,
+                       "completed_at": 106.0, "latency_s": 6.0}, f)
+        q.complete("req000", now=106.0, verdict="ok")
+        # req001: leased and still live at now=120
+        assert q.claim("req001", now=115.0)
+        # req002: claimed long ago, lease expired by now=120
+        assert q.claim("req002", now=105.0)
+        # req003: never claimed (waiting)
+        # req004: claim, fail, release -> back to waiting
+        assert q.claim("req004", now=107.0)
+        q.record_failure("req004", "transient", now=108.0)
+        q.release("req004", now=108.0)
+
+        live = q.stats(now=120.0)
+        state = replay(load_run(out), now=120.0)
+        assert state.queue_counts == live, (state.queue_counts, live)
+        assert state.counts["enqueued"] == 5
+        assert state.counts["served"] == 1
+        assert state.counts["pending"] == 4
+        r4 = state.requests["req004"]
+        assert r4.attempts_failed == 1 and r4.sub_state == "expired"
+        assert state.requests["req002"].sub_state == "expired"
+        assert state.requests["req001"].sub_state == "leased"
+
+    def test_synth_run_replays_served(self, tmp_path):
+        synth_run(str(tmp_path))
+        state = replay(load_run(str(tmp_path)))
+        assert state.counts == {"enqueued": 3, "served": 3, "shed": 0,
+                                "failed": 0, "pending": 0}
+        assert state.reference_domain == "co"
+        w0 = state.workers["w0"]
+        assert w0["claims"] == 3 and w0["done_summary"]["solved"] == 3
+        assert state.slo["p50_latency_s"] == 14.0
+
+    def test_clock_skew_recovery_oracle(self, tmp_path):
+        """A worker wall clock running +45s ahead must be recovered as
+        a ~-45s offset purely from happens-before edges."""
+        delta = 45.0
+        synth_run(str(tmp_path), skew_s=delta)
+        state = replay(load_run(str(tmp_path)))
+        est = state.clocks["w0"].est
+        # edges bound the offset to [-delta-5, -delta+5] here: the
+        # recovered estimate must sit within one edge-gap of -delta
+        assert state.clocks["w0"].feasible
+        assert abs(est + delta) <= 5.0 + 1e-6, est
+        # translated completion times are back near true time
+        r = state.requests["req000"]
+        assert abs((r.completed_at + est) - 110.0) <= 5.0 + 1e-6
+
+    def test_skewed_deadlines_judged_in_corrected_time(self, tmp_path):
+        # true completion 110..120 vs deadline 150: attained, even
+        # though the RAW worker stamps (155..165) would breach it
+        synth_run(str(tmp_path), skew_s=45.0, deadline=150.0)
+        state = replay(load_run(str(tmp_path)))
+        assert state.slo["deadline_judged"] == 3
+        assert state.slo["deadline_breaches"] == 0
+
+
+class TestAuditGate:
+    def test_clean_control_exits_zero(self, tmp_path):
+        synth_run(str(tmp_path))
+        report = run_audit(str(tmp_path))
+        assert report.violations == [], \
+            [v.render() for v in report.violations]
+        assert report.exit_code() == EXIT_OK
+
+    def test_insufficient_records(self, tmp_path):
+        report = run_audit(str(tmp_path))
+        assert report.insufficient
+        assert report.exit_code() == EXIT_INSUFFICIENT
+
+    @pytest.mark.parametrize("mode,kind", sorted(INJECTION_KINDS.items()))
+    def test_injection_arms_each_caught(self, mode, kind, tmp_path):
+        """The 4-arm fault-injection kit: every arm must produce its
+        pinned violation kind and exit 1 on an otherwise clean run."""
+        synth_run(str(tmp_path))
+        report = run_audit(str(tmp_path), inject=mode)
+        assert report.exit_code() == EXIT_VIOLATION
+        assert kind in report.kinds(), \
+            (mode, report.kinds(),
+             [v.render() for v in report.violations])
+
+    def test_injection_env_hook(self, tmp_path, monkeypatch):
+        synth_run(str(tmp_path))
+        monkeypatch.setenv("SAGECAL_AUDIT_INJECT", "forge_manifest")
+        report = run_audit(str(tmp_path))
+        assert report.exit_code() == EXIT_VIOLATION
+        assert "forged_manifest" in report.kinds()
+
+    def test_unknown_injection_mode_raises(self, tmp_path):
+        synth_run(str(tmp_path))
+        with pytest.raises(ValueError, match="drop_event"):
+            run_audit(str(tmp_path), inject="nonsense")
+
+    def test_skew_beyond_bound_flagged_within_bound_ok(self, tmp_path):
+        synth_run(str(tmp_path), skew_s=45.0)
+        flagged = run_audit(str(tmp_path), max_skew_s=30.0)
+        assert KIND_CLOCK_SKEW in flagged.kinds()
+        tolerated = run_audit(str(tmp_path), max_skew_s=120.0)
+        assert KIND_CLOCK_SKEW not in tolerated.kinds()
+
+    def test_missing_event_log_is_a_gap(self, tmp_path):
+        synth_run(str(tmp_path))
+        os.unlink(os.path.join(str(tmp_path), "sagecal_events.jsonl"))
+        report = run_audit(str(tmp_path))
+        assert KIND_GAP in report.kinds()
+        assert report.exit_code() == EXIT_VIOLATION
+
+    def test_torn_event_line_is_a_violation(self, tmp_path):
+        synth_run(str(tmp_path))
+        with open(os.path.join(str(tmp_path),
+                               "sagecal_events.jsonl"), "a") as f:
+            f.write('{"ts": 131.0, "run_id": "r", "type": "trunc')
+        report = run_audit(str(tmp_path))
+        assert "torn_record" in report.kinds()
+
+    def test_cli_exit_codes(self, tmp_path):
+        synth_run(str(tmp_path / "run"))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SAGECAL_AUDIT_INJECT", None)
+        base = [sys.executable, "-m", "sagecal_tpu.obs.diag"]
+        ok = subprocess.run(base + ["audit", str(tmp_path / "run")],
+                            capture_output=True, text=True, env=env)
+        assert ok.returncode == EXIT_OK, ok.stdout + ok.stderr
+        assert "AUDIT: OK" in ok.stdout
+        bad = subprocess.run(
+            base + ["audit", str(tmp_path / "run"),
+                    "--inject", "tear_record"],
+            capture_output=True, text=True, env=env)
+        assert bad.returncode == EXIT_VIOLATION
+        assert "[torn_record]" in bad.stdout
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        ins = subprocess.run(base + ["audit", str(empty)],
+                             capture_output=True, text=True, env=env)
+        assert ins.returncode == EXIT_INSUFFICIENT
+        rep = subprocess.run(base + ["replay", str(tmp_path / "run")],
+                             capture_output=True, text=True, env=env)
+        assert rep.returncode == EXIT_OK
+        assert "3 enqueued = 3 served" in rep.stdout
+
+    def test_injection_never_touches_files(self, tmp_path):
+        synth_run(str(tmp_path))
+        before = {}
+        for root, _d, files in os.walk(str(tmp_path)):
+            for n in files:
+                p = os.path.join(root, n)
+                before[p] = open(p, "rb").read()
+        for mode in INJECTION_KINDS:
+            rec = load_run(str(tmp_path))
+            apply_injection(rec, mode)
+        after = {p: open(p, "rb").read() for p in before}
+        assert before == after
+
+
+class TestBackfillTool:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(TOOLS, "backfill_record_schemas.py"),
+             *args],
+            capture_output=True, text=True)
+
+    def test_backfill_spans_flight_and_load_steps(self, tmp_path):
+        v1_span = {"kind": "span", "schema_version": 1, "trace_id": "t",
+                   "span_id": "1", "name": "solve", "ts": 1.0,
+                   "dur": 0.5, "pid": 77}
+        torn = '{"kind": "span", "schema_ver'
+        sp = tmp_path / "sagecal_trace.jsonl"
+        sp.write_text(json.dumps(v1_span) + "\n" + torn + "\n")
+        fd = tmp_path / "flight_dump.json"
+        fd.write_text(json.dumps({"schema_version": 1, "reason": "x",
+                                  "ts": 2.0, "pid": 88, "run_id": "r"}))
+        ls = tmp_path / "load_steps.json"
+        ls.write_text(json.dumps({"schema_version": 1,
+                                  "kind": "load_steps", "seed": 1,
+                                  "arrival": "poisson", "t_start": 0.0,
+                                  "steps": [], "submitted": 0}))
+
+        dry = self._run("--dry-run", str(tmp_path))
+        assert dry.returncode == 0, dry.stderr
+        assert sp.read_text().splitlines()[1] == torn  # untouched
+        assert json.loads(fd.read_text())["schema_version"] == 1
+
+        real = self._run(str(tmp_path))
+        assert real.returncode == 0, real.stderr
+        lines = sp.read_text().splitlines()
+        up = json.loads(lines[0])
+        assert up["schema_version"] == 2
+        assert up["writer"] == "p77@77" and up["writer_backfilled"]
+        assert "seq" not in up  # never invent sequence evidence
+        assert lines[1] == torn  # corrupt line byte-identical
+        fdoc = json.loads(fd.read_text())
+        assert fdoc["schema_version"] == 2 and fdoc["writer"] == "p88@88"
+        # load_steps v1 recorded no pid: reported, never guessed
+        assert json.loads(ls.read_text()).get("writer") is None
+        assert "unresolvable" in real.stdout
+
+        # backfilled records pass the validating reader
+        assert _nz(ledger.read_validated(
+            str(sp), ledger.family("span")).counts()) == \
+            {"ok": 1, "torn": 1}
+
+        again = self._run(str(tmp_path))
+        assert "0 record(s) rewrote" in again.stdout  # idempotent
+
+    def test_backfill_leaves_v2_alone(self, tmp_path):
+        v2 = {"kind": "span", "schema_version": 2, "trace_id": "t",
+              "span_id": "1", "name": "n", "ts": 1.0, "dur": 0.1,
+              "pid": 9, "writer": "w0@9", "mono": 0.5, "seq": 0}
+        sp = tmp_path / "sagecal_trace.jsonl"
+        raw = json.dumps(v2) + "\n"
+        sp.write_text(raw)
+        self._run(str(tmp_path))
+        assert sp.read_text() == raw
+
+
+class TestRegistryDocs:
+    def test_registry_table_covers_every_family(self):
+        table = ledger.registry_table()
+        assert {row["name"] for row in table} == \
+            {f.name for f in ledger.REGISTRY}
+        for row in table:
+            assert row["pattern"] and row["description"]
+
+    def test_domain_of(self):
+        assert domain_of("w0@123") == "w0"
+        assert domain_of("p77@77") == "p77"
+        assert domain_of(None) is None
+        assert domain_of("") is None

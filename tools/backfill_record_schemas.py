@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""One-shot writer-identity backfill for pre-audit record files.
+
+The fleet audit (PR 20) stamps every record family with a writer
+identity (``sagecal_tpu.obs.events.writer_identity``: ``<id>@<pid>``)
+so the replay engine can estimate per-writer clock skew and detect
+per-writer sequence holes.  Three families shipped before the stamp
+existed; this tool upgrades their banked v1 records in place:
+
+- **spans** (``*trace*.jsonl``, v1) — every v1 span already carries its
+  emitter ``pid``, so the writer is derivable exactly
+  (``p<pid>@<pid>``).  Upgraded rows get ``writer``,
+  ``writer_backfilled: true``, and ``schema_version: 2``.  No ``seq``
+  is invented — a retroactive sequence number would manufacture
+  hole-detection evidence that was never recorded.
+- **flight dumps** (``flight_dump*.json``, v1) — same: ``pid`` is in
+  the doc, the writer is derived, the version bumped.
+- **load_steps.json** (v1) — v1 recorded *no* pid, so the writer is
+  genuinely unrecoverable.  The file is reported as unresolvable and
+  LEFT AT v1 (the ledger accepts both versions); inventing an identity
+  would be evidence laundering.
+
+Already-v2 records, foreign lines, and unparseable lines pass through
+byte-identical.  Rewrites are atomic (tmp + ``os.replace``);
+``--dry-run`` prints the would-be changes without writing.  Idempotent:
+a second run is a no-op.
+
+Usage::
+
+    python tools/backfill_record_schemas.py RUN_DIR_OR_FILE [...]
+    python tools/backfill_record_schemas.py --dry-run out/
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sagecal_tpu.obs.flight import DUMP_SCHEMA_VERSION  # noqa: E402
+from sagecal_tpu.obs.trace import SPAN_SCHEMA_VERSION  # noqa: E402
+
+
+def _derived_writer(pid) -> str:
+    return f"p{int(pid)}@{int(pid)}"
+
+
+def backfill_span_line(line: str):
+    """(new_line, changed, resolved) for one span-log line; corrupt,
+    foreign, and already-v2 lines pass through untouched."""
+    stripped = line.strip()
+    if not stripped:
+        return line, False, True
+    try:
+        rec = json.loads(stripped)
+    except json.JSONDecodeError:
+        return line, False, True
+    if not isinstance(rec, dict) or rec.get("kind") != "span":
+        return line, False, True
+    if int(rec.get("schema_version", 1)) >= SPAN_SCHEMA_VERSION:
+        return line, False, True
+    if "pid" not in rec:
+        return line, False, False  # unresolvable: no identity recorded
+    if "writer" not in rec:
+        rec["writer"] = _derived_writer(rec["pid"])
+        rec["writer_backfilled"] = True
+    rec["schema_version"] = SPAN_SCHEMA_VERSION
+    return json.dumps(rec, default=str) + "\n", True, True
+
+
+def backfill_flight_doc(doc):
+    """(doc, changed, resolved) for a whole flight-dump document."""
+    if not isinstance(doc, dict) or "reason" not in doc:
+        return doc, False, True
+    if int(doc.get("schema_version", 1)) >= DUMP_SCHEMA_VERSION:
+        return doc, False, True
+    if "pid" not in doc:
+        return doc, False, False
+    if "writer" not in doc:
+        doc["writer"] = _derived_writer(doc["pid"])
+        doc["writer_backfilled"] = True
+    doc["schema_version"] = DUMP_SCHEMA_VERSION
+    return doc, True, True
+
+
+def _rewrite_atomic(path: str, data: str, dry_run: bool) -> None:
+    if dry_run:
+        return
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _process_span_file(path: str, dry_run: bool):
+    with open(path, "r", encoding="utf-8") as f:
+        lines = f.readlines()
+    out, n_changed, n_unresolved = [], 0, 0
+    for line in lines:
+        new_line, changed, resolved = backfill_span_line(line)
+        out.append(new_line)
+        n_changed += changed
+        n_unresolved += not resolved
+    if n_changed:
+        _rewrite_atomic(path, "".join(out), dry_run)
+    return n_changed, n_unresolved
+
+
+def _process_flight_file(path: str, dry_run: bool):
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return 0, 0
+    doc, changed, resolved = backfill_flight_doc(doc)
+    if changed:
+        _rewrite_atomic(path, json.dumps(doc, indent=2, default=str),
+                        dry_run)
+    return int(changed), int(not resolved)
+
+
+def _check_load_steps(path: str):
+    """v1 load_steps carries no pid: report, never rewrite."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return 0
+    if not isinstance(doc, dict) or doc.get("kind") != "load_steps":
+        return 0
+    if doc.get("writer") is not None:
+        return 0
+    return 1
+
+
+def _classify(path: str):
+    base = os.path.basename(path)
+    if fnmatch.fnmatch(base, "*trace*.jsonl*"):
+        return "span"
+    if fnmatch.fnmatch(base, "flight_dump*.json"):
+        return "flight"
+    if base == "load_steps.json":
+        return "load_steps"
+    return None
+
+
+def _targets(paths):
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for name in sorted(files):
+                    full = os.path.join(root, name)
+                    fam = _classify(full)
+                    if fam is not None and ".tmp." not in name:
+                        yield fam, full
+        else:
+            fam = _classify(p)
+            if fam is not None:
+                yield fam, p
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="backfill writer-identity stamps onto pre-audit "
+                    "span logs and flight dumps (load_steps v1 is "
+                    "reported unresolvable, never guessed)")
+    ap.add_argument("paths", nargs="+",
+                    help="run directories and/or individual record "
+                         "files (*trace*.jsonl, flight_dump*.json, "
+                         "load_steps.json)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="report what would change, write nothing")
+    args = ap.parse_args(argv)
+
+    n_files = n_changed = n_unresolved = 0
+    for fam, path in _targets(args.paths):
+        n_files += 1
+        if fam == "span":
+            c, u = _process_span_file(path, args.dry_run)
+        elif fam == "flight":
+            c, u = _process_flight_file(path, args.dry_run)
+        else:
+            c, u = 0, _check_load_steps(path)
+        n_changed += c
+        n_unresolved += u
+        if c or u:
+            print(f"{path}: {c} upgraded, {u} unresolvable")
+    verb = "would rewrite" if args.dry_run else "rewrote"
+    print(f"{n_files} record file(s) scanned, {n_changed} record(s) "
+          f"{verb}, {n_unresolved} unresolvable (left as-is)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

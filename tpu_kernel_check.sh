@@ -171,7 +171,7 @@ if probe; then SAGECAL_BENCH_COH_BF16=1 timeout 560 python bench.py; fi
 echo "=== telemetry+quality+trace+serve_obs+fleet+stream+sky+protocol+devprof+load+drift test pass (CPU, marker-driven)"
 JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 1200 \
   python -m pytest tests/ -q \
-  -m "telemetry or quality or trace or serve_obs or fleet or stream or sky or protocol or devprof or load or drift or kernelcheck" \
+  -m "telemetry or quality or trace or serve_obs or fleet or stream or sky or protocol or devprof or load or drift or kernelcheck or audit" \
   -p no:cacheprovider | tail -3
 rc=${PIPESTATUS[0]}
 if [ "$rc" != 0 ]; then echo "telemetry test pass FAILED rc=$rc"; exit 1; fi
@@ -416,7 +416,7 @@ proc = subprocess.Popen(
      "--batch", "2", "-e", "1", "-g", "2", "-l", "4", "-j", "1",
      "--lease-ttl", "4", "--max-idle", "20", "--f32"],
     stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-    env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    env=dict(os.environ, JAX_PLATFORMS="cpu", SAGECAL_TELEMETRY="1"))
 victim, lines = None, []
 for line in proc.stdout:
     lines.append(line)
@@ -440,6 +440,32 @@ assert all(d["verdict"] in ("ok", "degraded") for d in docs), \
 print("fleet smoke ok: 6/6 unique manifests complete after the kill")
 PY
 [ $? = 0 ] || { echo "fleet kill smoke FAILED"; exit 1; }
+echo "=== fleet audit gate (event-sourced replay + conservation laws)"
+# the run above is a REAL kill scenario: replay it purely from its
+# records and gate on the conservation laws (enqueued == served + shed
+# + failed + pending, one manifest per request, lease-epoch
+# monotonicity, clock-skew feasibility, no torn records)
+JAX_PLATFORMS=cpu timeout 120 python -m sagecal_tpu.obs.diag audit \
+  "$FLDIR" || { echo "FLEET AUDIT FAILED (violation or gap)"; exit 1; }
+# prove the detectors: each injected fault must be caught with its
+# pinned violation kind and exit 1 — a gate that passes clean runs but
+# cannot catch faults is no gate
+for arm in drop_event:sequence_hole tear_record:torn_record \
+           forge_manifest:forged_manifest skew_clock:clock_skew; do
+  mode=${arm%%:*}; kind=${arm##*:}
+  aout=$(SAGECAL_AUDIT_INJECT=$mode JAX_PLATFORMS=cpu timeout 120 \
+         python -m sagecal_tpu.obs.diag audit "$FLDIR" 2>&1)
+  arc=$?
+  if [ "$arc" != 1 ]; then
+    echo "AUDIT INJECTION $mode: expected exit 1, got $arc"
+    echo "$aout"; exit 1
+  fi
+  if ! echo "$aout" | grep -q "\[$kind\]"; then
+    echo "AUDIT INJECTION $mode: pinned kind $kind not reported"
+    echo "$aout"; exit 1
+  fi
+  echo "audit injection $mode caught as $kind"
+done
 rm -rf "$FLDIR"
 echo "=== load & capacity smoke (CPU, stepped load vs 2-worker fleet)"
 # the load harness end to end: a short seeded stepped-ramp run against
@@ -448,7 +474,8 @@ echo "=== load & capacity smoke (CPU, stepped load vs 2-worker fleet)"
 # the live/post-hoc/manifest views + depth reconciliation); the
 # report-only recommendation mirror, when present, must be well-formed
 LDDIR=$(mktemp -d)
-JAX_PLATFORMS=cpu timeout 560 python -m sagecal_tpu.apps.cli load \
+JAX_PLATFORMS=cpu SAGECAL_TELEMETRY=1 timeout 560 \
+  python -m sagecal_tpu.apps.cli load \
   --out-dir "$LDDIR" --workers 2 --rates 0.2,0.6 --step 12 \
   --tenants 2 --seed 23 --drain-timeout 300 \
   || { echo "load smoke run FAILED rc=$?"; exit 1; }
@@ -474,6 +501,10 @@ print("load smoke ok: %d samples, %d manifests, knee=%s" % (
     report["knee"]["knee_offered_rate"]))
 PY
 [ $? = 0 ] || { echo "load smoke validate FAILED"; exit 1; }
+# stepped-load audit gate: the open-loop run's records must replay to
+# a conserved fleet too (shed requests count as refusals, not losses)
+JAX_PLATFORMS=cpu timeout 120 python -m sagecal_tpu.obs.diag audit \
+  "$LDDIR" || { echo "LOAD AUDIT FAILED (violation or gap)"; exit 1; }
 rm -rf "$LDDIR"
 echo "=== widefield smoke (CPU, hier predict watchdog + kill-and-resume)"
 # the wide-field workload end to end: 300 sources collapsed to 3
